@@ -1,0 +1,48 @@
+"""poisson_ellipse_tpu — TPU-native fictitious-domain Poisson/PCG framework.
+
+A ground-up JAX/XLA/Pallas re-design of the reference project
+``mxy-kit/poisson-ellipse-openmp-mpi-cuda`` (mounted at ``/root/reference``):
+the 2D Poisson equation ``-Δu = f`` on the elliptic domain ``x² + 4y² < 1``
+embedded in ``Ω = [-1,1]×[-0.6,0.6]``, solved by the fictitious-domain method
+with a diagonally preconditioned conjugate-gradient (PCG) solver.
+
+Where the reference climbs through five hand-written parallel stages
+(sequential C++ → OpenMP → MPI 2D decomposition → MPI+OpenMP → MPI+CUDA),
+this framework expresses the same numerics once, TPU-first:
+
+- vectorised coefficient assembly (no loops; ``ops.assembly``),
+- 5-point variable-coefficient stencil + diagonal preconditioner as fused
+  XLA ops (``ops.stencil``), with Pallas kernel variants in ``ops.pallas``,
+- the full PCG loop on-device inside ``lax.while_loop`` — α, β and the
+  convergence decision never leave the chip (``solver.pcg``),
+- 2D spatial domain decomposition over a ``jax.sharding.Mesh`` with
+  explicit 1-cell halo exchange via ``lax.ppermute`` over ICI and global
+  reductions via ``lax.psum`` (``parallel``), replacing the reference's
+  ``MPI_Sendrecv`` / ``MPI_Allreduce`` backend,
+- a native C++/OpenMP host runtime for CPU-side work (``runtime``),
+  covering the reference's stage0/stage1 capabilities natively.
+
+(Consult each subpackage's module list for what has landed; this docstring
+describes the framework's architecture.)
+
+Stage parity map (reference → here):
+  stage0 sequential  → ``runtime`` C++ solver (1 thread) / single-chip JAX
+  stage1 OpenMP      → ``runtime`` C++ solver (OMP_NUM_THREADS)
+  stage2 MPI         → ``parallel`` sharded solver over a device mesh
+  stage3 MPI+OpenMP  → mesh sharding × XLA intra-chip parallelism
+  stage4 MPI+CUDA    → single/multi-chip TPU path with Pallas kernels
+"""
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg, solve
+from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Problem",
+    "PCGResult",
+    "pcg",
+    "solve",
+    "l2_error_vs_analytic",
+]

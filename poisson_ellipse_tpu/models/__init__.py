@@ -1,0 +1,7 @@
+"""Problem definitions (reference layer L0): domain geometry, constants,
+analytic control solution."""
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.models import ellipse
+
+__all__ = ["Problem", "ellipse"]

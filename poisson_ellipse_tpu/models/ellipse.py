@@ -1,0 +1,58 @@
+"""Elliptic domain D = {x² + 4y² < 1} (reference "variant 9") — vectorised.
+
+The reference implements this geometry as scalar functions called per cell
+inside OpenMP/CUDA loops (``stage0/Withoutopenmp1.cpp:14-16`` membership,
+``:19-39`` closed-form segment∩ellipse length). Here the same closed forms
+are written as broadcast ``jnp`` expressions over whole coordinate arrays —
+one fused XLA kernel assembles the entire grid, no loops.
+
+All branches become ``jnp.where``; square roots are clamped at zero before
+evaluation so the gradients/values are well-defined everywhere.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def is_in_d(x, y):
+    """Membership mask of the open ellipse x² + 4y² < 1.
+
+    Reference: ``stage0/Withoutopenmp1.cpp:14-16``.
+    """
+    return x * x + 4.0 * y * y < 1.0
+
+
+def analytic_solution(x, y):
+    """The exact solution u = (1 − x² − 4y²)/10 of -Δu = 1 on D with u|∂D = 0.
+
+    Stated as the accuracy control in the reference (``README.md:38-42``)
+    but never evaluated by its code; here it is first-class.
+    """
+    return (1.0 - x * x - 4.0 * y * y) / 10.0
+
+
+def segment_length_vertical(x0, y_start, y_end):
+    """Length of {x0} × [y_start, y_end] ∩ D.
+
+    Closed form: for |x0| < 1 the ellipse spans |y| ≤ sqrt((1-x0²)/4).
+    Reference: ``stage0/Withoutopenmp1.cpp:21-28`` (is_ver branch).
+    """
+    y_max = jnp.sqrt(jnp.maximum(0.0, (1.0 - x0 * x0) / 4.0))
+    length = jnp.maximum(
+        0.0, jnp.minimum(y_end, y_max) - jnp.maximum(y_start, -y_max)
+    )
+    return jnp.where(jnp.abs(x0) >= 1.0, 0.0, length)
+
+
+def segment_length_horizontal(y0, x_start, x_end):
+    """Length of [x_start, x_end] × {y0} ∩ D.
+
+    Closed form: for |2·y0| < 1 the ellipse spans |x| ≤ sqrt(1-4y0²).
+    Reference: ``stage0/Withoutopenmp1.cpp:29-37`` (horizontal branch).
+    """
+    x_max = jnp.sqrt(jnp.maximum(0.0, 1.0 - 4.0 * y0 * y0))
+    length = jnp.maximum(
+        0.0, jnp.minimum(x_end, x_max) - jnp.maximum(x_start, -x_max)
+    )
+    return jnp.where(jnp.abs(2.0 * y0) >= 1.0, 0.0, length)

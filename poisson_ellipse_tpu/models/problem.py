@@ -1,0 +1,78 @@
+"""Problem specification for the fictitious-domain Poisson solve.
+
+Mirrors the reference's compile-time constants and derived quantities
+(``stage0/Withoutopenmp1.cpp:9-11`` for A1/B1/A2/B2/F_VAL,
+``:107-108`` for h1/h2/eps, ``:182`` for max_iter=(M-1)(N-1),
+``:178`` for delta=1e-6) as one frozen, hashable dataclass so it can be
+closed over by jitted functions as a static argument.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Problem:
+    """Discretisation of -Δu = f on D = {x² + 4y² < 1} ⊂ Ω = [a1,b1]×[a2,b2].
+
+    M, N       : number of grid cells in x / y; nodes are 0..M × 0..N.
+    norm       : convergence-norm convention for ‖w^{k+1} − w^k‖:
+                 "weighted"   → sqrt(Σ dw² · h1·h2)  (stages 1-4,
+                                 ``stage1-openmp/Withopenmp1.cpp:182-189``,
+                                 ``stage4 poisson_mpi_cuda2.cu:626-660``)
+                 "unweighted" → sqrt(Σ dw²)          (stage0 variant 1,
+                                 ``stage0/Withoutopenmp1.cpp:149-154``)
+                 Iteration-count oracles (committed reference code, verified
+                 by compiling/running it): unweighted 17/31/61 at
+                 10²/20²/40²; weighted 50 at 40².
+    delta      : stopping threshold on the norm above (1e-6 in all stages).
+    eps        : fictitious-domain penetration parameter; default
+                 max(h1,h2)² as in ``stage0/Withoutopenmp1.cpp:108``.
+    max_iter   : PCG iteration cap; default (M-1)(N-1).
+    """
+
+    M: int
+    N: int
+    a1: float = -1.0
+    b1: float = 1.0
+    a2: float = -0.6
+    b2: float = 0.6
+    f_val: float = 1.0
+    delta: float = 1e-6
+    norm: str = "weighted"
+    eps: Optional[float] = None
+    max_iter: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.M < 2 or self.N < 2:
+            raise ValueError("need M >= 2 and N >= 2 for a nonempty interior")
+        if self.norm not in ("weighted", "unweighted"):
+            raise ValueError(f"unknown norm convention: {self.norm!r}")
+
+    @property
+    def h1(self) -> float:
+        return (self.b1 - self.a1) / self.M
+
+    @property
+    def h2(self) -> float:
+        return (self.b2 - self.a2) / self.N
+
+    @property
+    def eps_value(self) -> float:
+        if self.eps is not None:
+            return self.eps
+        h = max(self.h1, self.h2)
+        return h * h
+
+    @property
+    def max_iterations(self) -> int:
+        if self.max_iter is not None:
+            return self.max_iter
+        return (self.M - 1) * (self.N - 1)
+
+    @property
+    def node_shape(self) -> tuple[int, int]:
+        """Shape of the full node grid including the Dirichlet boundary."""
+        return (self.M + 1, self.N + 1)

@@ -1,0 +1,31 @@
+"""Compute ops (reference layers L1 + L3): coefficient assembly, the 5-point
+variable-coefficient stencil, the diagonal preconditioner, and grid-weighted
+reductions — all as pure, jittable functions that XLA fuses on TPU."""
+
+from poisson_ellipse_tpu.ops.assembly import (
+    coefficients_at,
+    rhs_at,
+    assemble,
+    assemble_on_device,
+)
+from poisson_ellipse_tpu.ops.stencil import (
+    apply_a,
+    apply_a_block,
+    diag_d,
+    diag_d_block,
+    apply_dinv,
+)
+from poisson_ellipse_tpu.ops.reduction import grid_dot
+
+__all__ = [
+    "coefficients_at",
+    "rhs_at",
+    "assemble",
+    "assemble_on_device",
+    "apply_a",
+    "apply_a_block",
+    "diag_d",
+    "diag_d_block",
+    "apply_dinv",
+    "grid_dot",
+]

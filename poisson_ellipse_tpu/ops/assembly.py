@@ -1,0 +1,194 @@
+"""Fictitious-domain coefficient assembly (reference layer L1), vectorised.
+
+The reference assembles per-edge diffusion coefficients a_ij (vertical faces)
+and b_ij (horizontal faces) plus the indicator RHS B_ij in nested loops on
+the CPU host (``stage0/Withoutopenmp1.cpp:42-61``; the distributed variant
+``fictitious_regions_setup_local`` at ``stage4-mpi+cuda/poisson_mpi_cuda2.cu:146-192``
+assembles each rank's block + one halo ring from *global* indices, with no
+communication).
+
+This module keeps exactly that contract, TPU-style: every function takes
+arrays of **global node indices** ``gi``/``gj`` and evaluates the closed-form
+geometry by broadcasting — so the same code assembles the whole grid on one
+chip (``gi = 0..M``) or any device's halo-extended block inside ``shard_map``
+(``gi = r0-1 .. r1``), with out-of-range indices masked to zero. No loops,
+no communication, no host work.
+
+Coefficient law (``stage0/Withoutopenmp1.cpp:53-54``; README.md:44-57):
+    a_ij = 1                         face fully inside D  (|l − h2| < 1e-9)
+         = 1/eps                     face fully outside   (l < 1e-9)
+         = l/h2 + (1 − l/h2)/eps     cut face (length-weighted blend)
+with eps = max(h1,h2)² by default, and symmetrically for b with h1.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.models import ellipse
+from poisson_ellipse_tpu.models.problem import Problem
+
+# Tolerances from the reference's blend law (stage0/Withoutopenmp1.cpp:53-54).
+_FULL_TOL = 1e-9
+_EMPTY_TOL = 1e-9
+
+
+def _blend(length, h, eps):
+    """Piecewise coefficient law for one face of length-in-D ``length``."""
+    frac = length / h
+    cut = frac + (1.0 - frac) / eps
+    return jnp.where(
+        jnp.abs(length - h) < _FULL_TOL,
+        1.0,
+        jnp.where(length < _EMPTY_TOL, 1.0 / eps, cut),
+    )
+
+
+def coefficients_at(problem: Problem, gi, gj, dtype=jnp.float32):
+    """Assemble (a, b) at the outer product of global node indices gi × gj.
+
+    a[i,j] lives on the vertical face x = x_i − h1/2, y ∈ [y_j − h2/2, y_j + h2/2];
+    b[i,j] on the horizontal face y = y_j − h2/2, x ∈ [x_i − h1/2, x_i + h1/2]
+    (``stage0/Withoutopenmp1.cpp:49-54``). Valid for 1 ≤ gi ≤ M, 1 ≤ gj ≤ N;
+    indices outside that range (physical boundary ring, shard padding) yield 0,
+    mirroring the zero-initialised (M+1)×(N+1) arrays of the reference.
+    """
+    gi = jnp.asarray(gi)
+    gj = jnp.asarray(gj)
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    eps = jnp.asarray(problem.eps_value, dtype)
+    x = problem.a1 + gi.astype(dtype) * h1
+    y = problem.a2 + gj.astype(dtype) * h2
+    xc = x[:, None]
+    yc = y[None, :]
+    la = ellipse.segment_length_vertical(xc - 0.5 * h1, yc - 0.5 * h2, yc + 0.5 * h2)
+    lb = ellipse.segment_length_horizontal(yc - 0.5 * h2, xc - 0.5 * h1, xc + 0.5 * h1)
+    a = _blend(la, h2, eps)
+    b = _blend(lb, h1, eps)
+    valid = (
+        ((gi >= 1) & (gi <= problem.M))[:, None]
+        & ((gj >= 1) & (gj <= problem.N))[None, :]
+    )
+    zero = jnp.asarray(0.0, dtype)
+    return jnp.where(valid, a, zero), jnp.where(valid, b, zero)
+
+
+def rhs_at(problem: Problem, gi, gj, dtype=jnp.float32):
+    """Indicator right-hand side B_ij = f_val·1[node inside D] on the interior.
+
+    Reference: ``stage0/Withoutopenmp1.cpp:57-60`` — B is f_val at interior
+    nodes (1 ≤ i ≤ M−1, 1 ≤ j ≤ N−1) strictly inside the ellipse, else 0.
+    """
+    gi = jnp.asarray(gi)
+    gj = jnp.asarray(gj)
+    x = problem.a1 + gi.astype(dtype) * jnp.asarray(problem.h1, dtype)
+    y = problem.a2 + gj.astype(dtype) * jnp.asarray(problem.h2, dtype)
+    inside = ellipse.is_in_d(x[:, None], y[None, :])
+    interior = interior_mask(problem, gi, gj)
+    return jnp.where(
+        inside & interior, jnp.asarray(problem.f_val, dtype), jnp.asarray(0.0, dtype)
+    )
+
+
+def interior_mask(problem: Problem, gi, gj):
+    """Boolean mask of interior nodes 1 ≤ gi ≤ M−1, 1 ≤ gj ≤ N−1."""
+    gi = jnp.asarray(gi)
+    gj = jnp.asarray(gj)
+    return (
+        ((gi >= 1) & (gi <= problem.M - 1))[:, None]
+        & ((gj >= 1) & (gj <= problem.N - 1))[None, :]
+    )
+
+
+def _assemble_numpy_f64(problem: Problem):
+    """Full-precision host assembly in vectorised numpy float64.
+
+    The geometry MUST be evaluated in f64 regardless of the solve dtype:
+    segment lengths carry absolute rounding noise ~machine-eps of O(1)
+    coordinates, and the cut-face blend amplifies any noise in l/h by
+    1/eps = 1/max(h1,h2)² — in f32 that turns into O(10) errors (and even
+    negative, SPD-breaking coefficients) on fine grids like 1024²+.
+    Evaluating in f64 and *then* casting keeps coefficients exact to the
+    target dtype's resolution. This mirrors the reference, which always
+    assembles on the host in double (``poisson_mpi_cuda2.cu:146-192``).
+    """
+    M, N = problem.M, problem.N
+    h1, h2 = problem.h1, problem.h2
+    eps = problem.eps_value
+    gi = np.arange(M + 1, dtype=np.float64)
+    gj = np.arange(N + 1, dtype=np.float64)
+    x = problem.a1 + gi * h1
+    y = problem.a2 + gj * h2
+    xc = x[:, None]
+    yc = y[None, :]
+
+    # segment ∩ ellipse closed forms (stage0/Withoutopenmp1.cpp:19-39)
+    x0 = xc - 0.5 * h1
+    y_max = np.sqrt(np.maximum(0.0, (1.0 - x0 * x0) / 4.0))
+    la = np.maximum(
+        0.0, np.minimum(yc + 0.5 * h2, y_max) - np.maximum(yc - 0.5 * h2, -y_max)
+    )
+    la = np.where(np.abs(x0) >= 1.0, 0.0, la)
+    y0 = yc - 0.5 * h2
+    x_max = np.sqrt(np.maximum(0.0, 1.0 - 4.0 * y0 * y0))
+    lb = np.maximum(
+        0.0, np.minimum(xc + 0.5 * h1, x_max) - np.maximum(xc - 0.5 * h1, -x_max)
+    )
+    lb = np.where(np.abs(2.0 * y0) >= 1.0, 0.0, lb)
+
+    def blend(length, h):
+        frac = length / h
+        return np.where(
+            np.abs(length - h) < _FULL_TOL,
+            1.0,
+            np.where(length < _EMPTY_TOL, 1.0 / eps, frac + (1.0 - frac) / eps),
+        )
+
+    valid = ((gi >= 1) & (gi <= M))[:, None] & ((gj >= 1) & (gj <= N))[None, :]
+    a = np.where(valid, blend(la, h2), 0.0)
+    b = np.where(valid, blend(lb, h1), 0.0)
+
+    inside = xc * xc + 4.0 * yc * yc < 1.0
+    interior = ((gi >= 1) & (gi <= M - 1))[:, None] & (
+        (gj >= 1) & (gj <= N - 1)
+    )[None, :]
+    rhs = np.where(inside & interior, problem.f_val, 0.0)
+    return a, b, rhs
+
+
+def assemble(problem: Problem, dtype=jnp.float32):
+    """Assemble the full global (a, b, rhs) node-grid arrays, shape (M+1, N+1).
+
+    Geometry is evaluated on the host in float64 (see ``_assemble_numpy_f64``
+    for why this is mandatory) and cast to ``dtype`` — a one-time setup cost,
+    exactly as the reference assembles on the CPU host before uploading
+    (``poisson_mpi_cuda2.cu:716-759``). Row/col 0 of a,b are zero, matching
+    the reference's (M+1)×(N+1) zero-initialised vectors
+    (``stage0/Withoutopenmp1.cpp:111-112``).
+    """
+    a, b, rhs = _assemble_numpy_f64(problem)
+    return (
+        jnp.asarray(a.astype(_np_dtype(dtype))),
+        jnp.asarray(b.astype(_np_dtype(dtype))),
+        jnp.asarray(rhs.astype(_np_dtype(dtype))),
+    )
+
+
+def _np_dtype(dtype):
+    return np.dtype(jnp.dtype(dtype).name)
+
+
+def assemble_on_device(problem: Problem, dtype=jnp.float32):
+    """Assemble the full grid with traced jnp ops (no host work).
+
+    Only use where the trace dtype is f64 (e.g. the CPU-mesh distributed
+    tests with x64 enabled) or on coarse grids — see ``_assemble_numpy_f64``
+    for the f32 precision hazard.
+    """
+    gi = jnp.arange(problem.M + 1)
+    gj = jnp.arange(problem.N + 1)
+    a, b = coefficients_at(problem, gi, gj, dtype)
+    rhs = rhs_at(problem, gi, gj, dtype)
+    return a, b, rhs

@@ -1,0 +1,26 @@
+"""Grid-weighted reductions (reference layer L3, reduction side).
+
+The reference's ``dot`` is the h1·h2-weighted inner product over interior
+nodes (``stage0/Withoutopenmp1.cpp:64-72``); its CUDA form produces 32768
+partial sums that are finished on the host (``poisson_mpi_cuda2.cu:574-598``,
+``:779-785``). On TPU the whole reduction is one fused on-device ``jnp.sum``
+— no partials, no host.
+
+All iterate arrays (w, r, z, p) are maintained exactly zero outside the
+interior, so summing the full array equals the interior sum while keeping
+the reduction a single dense XLA op (better for the VPU than masked slices).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def grid_dot(u, v, h1, h2):
+    """(u, v) = h1·h2 · Σ u_ij v_ij (interior; arrays are zero elsewhere)."""
+    return jnp.sum(u * v) * h1 * h2
+
+
+def grid_sumsq(u):
+    """Unweighted Σ u²  — used by the stage0 convergence-norm convention."""
+    return jnp.sum(u * u)

@@ -1,0 +1,6 @@
+"""Solver drivers (reference layer L5): the preconditioned conjugate-gradient
+iteration as a fully on-device ``lax.while_loop``."""
+
+from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg, solve
+
+__all__ = ["PCGResult", "pcg", "solve"]

@@ -1,0 +1,130 @@
+"""Single-chip preconditioned conjugate gradients, fully on-device.
+
+The reference's PCG drivers (sequential ``solve`` at
+``stage0/Withoutopenmp1.cpp:106-172``; distributed ``gradient_solver_mpi`` at
+``stage4-mpi+cuda/poisson_mpi_cuda2.cu:687-982``) keep the scalar recurrence
+(α, β, convergence decision) on the **host**, costing the CUDA stage ≥3
+device↔host round-trips per iteration (dot partials + diff partials) plus a
+device sync after every kernel. Here the entire loop — stencil, dots, axpy
+updates, preconditioner, stopping rule — is one ``lax.while_loop`` traced
+into a single XLA computation: zero host↔device transfers per iteration,
+which is exactly the north-star design of BASELINE.json.
+
+Semantics preserved from the reference loop, in order
+(``stage0/Withoutopenmp1.cpp:124-169``):
+  1. Ap = A·p;  denom = (Ap, p);  breakdown-exit if denom < 1e-15
+  2. α = zr/denom;  w += αp;  r −= αAp
+  3. z = D⁻¹r;  zr_new = (z, r)
+  4. diff = ‖w^{k+1} − w^k‖ (norm convention per Problem.norm);
+     converged-exit if diff < δ
+  5. β = zr_new/zr;  p = z + βp
+The returned iteration count matches the reference's (count of loop bodies
+entered, including the one that triggers the exit).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.reduction import grid_dot, grid_sumsq
+from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
+
+# PCG breakdown guard on the (Ap, p) denominator (stage0/Withoutopenmp1.cpp:128).
+DENOM_GUARD = 1e-15
+
+
+class PCGResult(NamedTuple):
+    """Solver output: solution grid, iterations, final step-norm, exit flags."""
+
+    w: jax.Array
+    iters: jax.Array
+    diff: jax.Array
+    converged: jax.Array
+    breakdown: jax.Array
+
+
+def pcg(problem: Problem, a, b, rhs):
+    """Run PCG for pre-assembled coefficients. All inputs (M+1, N+1).
+
+    Jit-safe with ``problem`` static; the while_loop carries
+    (k, w, r, p, zr, diff, converged, breakdown) entirely on device.
+    """
+    dtype = rhs.dtype
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    delta = jnp.asarray(problem.delta, dtype)
+    max_iter = problem.max_iterations
+    weighted = problem.norm == "weighted"
+
+    d = diag_d(a, b, h1, h2)
+
+    w0 = jnp.zeros_like(rhs)
+    r0 = rhs
+    z0 = apply_dinv(r0, d)
+    p0 = z0
+    zr0 = grid_dot(z0, r0, h1, h2)
+
+    def cond(state):
+        k, _w, _r, _p, _zr, _diff, converged, breakdown = state
+        return (k < max_iter) & ~converged & ~breakdown
+
+    def body(state):
+        k, w, r, p, zr, _diff, _c, _bd = state
+        ap = apply_a(p, a, b, h1, h2)
+        denom = grid_dot(ap, p, h1, h2)
+        breakdown = denom < DENOM_GUARD
+        alpha = zr / jnp.where(breakdown, 1.0, denom)
+
+        w_new = w + alpha * p
+        r_new = r - alpha * ap
+        z = apply_dinv(r_new, d)
+        zr_new = grid_dot(z, r_new, h1, h2)
+
+        # ‖w^{k+1} − w^k‖ computed from the realised update (w_new − w), not
+        # α·p, for bitwise parity with the reference's w/w_prev difference
+        # (stage0/Withoutopenmp1.cpp:149-154; stage4 update_w_r_kernel
+        # poisson_mpi_cuda2.cu:626-660).
+        dw2 = grid_sumsq(w_new - w)
+        diff = jnp.sqrt(dw2 * h1 * h2) if weighted else jnp.sqrt(dw2)
+        # a breakdown iteration discards its update, so it cannot also claim
+        # convergence; report the diff of the state actually retained
+        converged = ~breakdown & (diff < delta)
+        diff = jnp.where(breakdown, _diff, diff)
+
+        beta = zr_new / zr
+        p_new = z + beta * p
+
+        # On breakdown the reference exits *before* touching w/r (stage0:128);
+        # keep the pre-update iterates in that (rare, terminal) case.
+        w_out = jnp.where(breakdown, w, w_new)
+        r_out = jnp.where(breakdown, r, r_new)
+        p_out = jnp.where(breakdown | converged, p, p_new)
+        zr_out = jnp.where(breakdown | converged, zr, zr_new)
+        return (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
+
+    state0 = (
+        jnp.asarray(0, jnp.int32),
+        w0,
+        r0,
+        p0,
+        zr0,
+        jnp.asarray(jnp.inf, dtype),
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+    k, w, _r, _p, _zr, diff, converged, breakdown = lax.while_loop(
+        cond, body, state0
+    )
+    return PCGResult(w=w, iters=k, diff=diff, converged=converged, breakdown=breakdown)
+
+
+def solve(problem: Problem, dtype=jnp.float32) -> PCGResult:
+    """Assemble and solve on a single chip (the stage0-shaped entry point)."""
+    a, b, rhs = assembly.assemble(problem, dtype)
+    return pcg(problem, a, b, rhs)

@@ -1,0 +1,5 @@
+"""Shared utilities: grids, error norms vs the analytic control solution."""
+
+from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic, residual_norm
+
+__all__ = ["l2_error_vs_analytic", "residual_norm"]

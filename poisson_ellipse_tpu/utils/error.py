@@ -1,0 +1,46 @@
+"""Accuracy instrumentation vs the analytic control solution.
+
+The reference *states* u = (1 − x² − 4y²)/10 as its accuracy control
+(``README.md:38-42``) but no stage ever computes an error against it
+(verified: no error computation exists in any source). BASELINE.json makes
+"L2 error vs analytic" a first-class metric of this framework, so it lives
+here: the discrete L2 norm h1·h2-weighted over interior nodes strictly
+inside D (the analytic solution is only meaningful inside the ellipse; the
+fictitious exterior carries O(eps) garbage by design).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models import ellipse
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.reduction import grid_dot
+from poisson_ellipse_tpu.ops.stencil import apply_a
+
+
+def _interior_coords(problem: Problem, dtype):
+    gi = jnp.arange(problem.M + 1, dtype=dtype)
+    gj = jnp.arange(problem.N + 1, dtype=dtype)
+    x = problem.a1 + gi * jnp.asarray(problem.h1, dtype)
+    y = problem.a2 + gj * jnp.asarray(problem.h2, dtype)
+    return x[:, None], y[None, :]
+
+
+def l2_error_vs_analytic(problem: Problem, w):
+    """sqrt( h1·h2 · Σ_{nodes in D} (w_ij − u(x_i, y_j))² )."""
+    dtype = w.dtype
+    x, y = _interior_coords(problem, dtype)
+    u = ellipse.analytic_solution(x, y)
+    in_d = ellipse.is_in_d(x, y)
+    err2 = jnp.where(in_d, (w - u) ** 2, 0.0)
+    return jnp.sqrt(jnp.sum(err2) * problem.h1 * problem.h2)
+
+
+def residual_norm(problem: Problem, w, a, b, rhs):
+    """‖B − A·w‖ in the grid-weighted norm — a solver-independent check."""
+    dtype = w.dtype
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    r = rhs - apply_a(w, a, b, h1, h2)
+    return jnp.sqrt(grid_dot(r, r, h1, h2))

@@ -1,0 +1,149 @@
+"""Assembly (L1) tests: golden comparison against an independent numpy port
+of the reference's fic_reg (stage0/Withoutopenmp1.cpp:42-61), plus
+block-local assembly consistency (the fictitious_regions_setup_local
+contract, poisson_mpi_cuda2.cu:146-192)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+
+
+def reference_assembly_numpy(problem: Problem):
+    """Literal scalar-loop port of fic_reg for golden comparison."""
+    M, N, h1, h2 = problem.M, problem.N, problem.h1, problem.h2
+    eps = problem.eps_value
+    a = np.zeros((M + 1, N + 1))
+    b = np.zeros((M + 1, N + 1))
+    rhs = np.zeros((M + 1, N + 1))
+
+    def seg_v(x0, ys, ye):
+        if abs(x0) >= 1.0:
+            return 0.0
+        ym = math.sqrt(max(0.0, (1.0 - x0 * x0) / 4.0))
+        return max(0.0, min(ye, ym) - max(ys, -ym))
+
+    def seg_h(y0, xs, xe):
+        if abs(2.0 * y0) >= 1.0:
+            return 0.0
+        xm = math.sqrt(max(0.0, 1.0 - 4.0 * y0 * y0))
+        return max(0.0, min(xe, xm) - max(xs, -xm))
+
+    for i in range(1, M + 1):
+        for j in range(1, N + 1):
+            x = problem.a1 + i * h1
+            y = problem.a2 + j * h2
+            la = seg_v(x - 0.5 * h1, y - 0.5 * h2, y + 0.5 * h2)
+            lb = seg_h(y - 0.5 * h2, x - 0.5 * h1, x + 0.5 * h1)
+            a[i, j] = (
+                1.0
+                if abs(la - h2) < 1e-9
+                else (1.0 / eps if la < 1e-9 else la / h2 + (1.0 - la / h2) / eps)
+            )
+            b[i, j] = (
+                1.0
+                if abs(lb - h1) < 1e-9
+                else (1.0 / eps if lb < 1e-9 else lb / h1 + (1.0 - lb / h1) / eps)
+            )
+    for i in range(1, M):
+        for j in range(1, N):
+            x = problem.a1 + i * h1
+            y = problem.a2 + j * h2
+            rhs[i, j] = problem.f_val if x * x + 4 * y * y < 1 else 0.0
+    return a, b, rhs
+
+
+@pytest.mark.parametrize("M,N", [(10, 10), (20, 20), (13, 17)])
+def test_assembly_matches_reference_port(M, N):
+    problem = Problem(M=M, N=N)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    a_ref, b_ref, rhs_ref = reference_assembly_numpy(problem)
+    np.testing.assert_allclose(np.asarray(a), a_ref, rtol=0, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(b), b_ref, rtol=0, atol=1e-12)
+    np.testing.assert_array_equal(np.asarray(rhs), rhs_ref)
+
+
+def test_coefficient_values_in_expected_set():
+    problem = Problem(M=40, N=40)
+    a, b, _ = assembly.assemble(problem, jnp.float64)
+    inv_eps = 1.0 / problem.eps_value
+    for arr in (np.asarray(a), np.asarray(b)):
+        interior = arr[1:, 1:]
+        assert interior.min() >= 1.0 - 1e-12
+        assert interior.max() <= inv_eps + 1e-6
+        # both regimes must actually occur on this grid
+        assert (np.abs(interior - 1.0) < 1e-12).any()
+        assert (np.abs(interior - inv_eps) < 1e-6 * inv_eps).any()
+
+
+def test_boundary_rows_are_zero():
+    problem = Problem(M=12, N=14)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    assert np.asarray(a[0]).max() == 0.0 and np.asarray(a[:, 0]).max() == 0.0
+    assert np.asarray(b[0]).max() == 0.0 and np.asarray(b[:, 0]).max() == 0.0
+    # rhs vanishes on the entire Dirichlet ring
+    r = np.asarray(rhs)
+    assert r[0].max() == 0 and r[-1].max() == 0
+    assert r[:, 0].max() == 0 and r[:, -1].max() == 0
+
+
+def test_block_local_assembly_matches_global_slices():
+    """Assembling a halo-extended block from global indices must equal the
+    corresponding slice of the global arrays — the stage2/4 local-assembly
+    contract (no communication needed for coefficients)."""
+    problem = Problem(M=16, N=12)
+    a_g, b_g, rhs_g = assembly.assemble(problem, jnp.float64)
+    # a block owning global rows 4..9, cols 6..11, extended by one halo ring
+    gi = jnp.arange(4 - 1, 10 + 1)
+    gj = jnp.arange(6 - 1, 12 + 1)
+    a_blk, b_blk = assembly.coefficients_at(problem, gi, gj, jnp.float64)
+    rhs_blk = assembly.rhs_at(problem, gi, gj, jnp.float64)
+    np.testing.assert_array_equal(np.asarray(a_blk), np.asarray(a_g[3:11, 5:13]))
+    np.testing.assert_array_equal(np.asarray(b_blk), np.asarray(b_g[3:11, 5:13]))
+    np.testing.assert_array_equal(np.asarray(rhs_blk), np.asarray(rhs_g[3:11, 5:13]))
+
+
+def test_f32_assembly_stays_positive_on_fine_grids():
+    """Regression: f32 on-device geometry noise amplified by 1/eps used to
+    produce negative (SPD-breaking) coefficients at fine grids; host f64
+    assembly + cast must keep every face coefficient >= 1."""
+    problem = Problem(M=1024, N=1024)
+    a, b, _ = assembly.assemble(problem, jnp.float32)
+    assert a.dtype == jnp.float32
+    a_int = np.asarray(a)[1:, 1:]
+    b_int = np.asarray(b)[1:, 1:]
+    assert a_int.min() >= 1.0 - 1e-6
+    assert b_int.min() >= 1.0 - 1e-6
+    # f64 assembly of the same grid, cast afterwards, must agree exactly
+    a64, b64, _ = assembly.assemble(problem, jnp.float64)
+    np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(a64).astype(np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b), np.asarray(b64).astype(np.float32)
+    )
+
+
+def test_on_device_assembly_matches_host_in_f64():
+    problem = Problem(M=24, N=18)
+    a_h, b_h, r_h = assembly.assemble(problem, jnp.float64)
+    a_d, b_d, r_d = assembly.assemble_on_device(problem, jnp.float64)
+    np.testing.assert_allclose(np.asarray(a_h), np.asarray(a_d), rtol=0, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(b_h), np.asarray(b_d), rtol=0, atol=1e-12)
+    np.testing.assert_array_equal(np.asarray(r_h), np.asarray(r_d))
+
+
+def test_block_assembly_out_of_range_is_zero():
+    problem = Problem(M=8, N=8)
+    gi = jnp.arange(6, 12)  # extends past M=8
+    gj = jnp.arange(-2, 4)  # extends below 0
+    a_blk, b_blk = assembly.coefficients_at(problem, gi, gj, jnp.float64)
+    rhs_blk = assembly.rhs_at(problem, gi, gj, jnp.float64)
+    a_np, b_np, r_np = map(np.asarray, (a_blk, b_blk, rhs_blk))
+    assert a_np[np.asarray(gi) > 8, :].max(initial=0) == 0.0
+    assert b_np[:, np.asarray(gj) < 1].max(initial=0) == 0.0
+    assert r_np[np.asarray(gi) > 7, :].max(initial=0) == 0.0

@@ -1,0 +1,86 @@
+"""Unit tests for the ellipse geometry (reference L0) against an independent
+scalar re-derivation of the closed forms in stage0/Withoutopenmp1.cpp:19-39."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.models import ellipse
+
+
+def seg_len_vertical_scalar(x0, ys, ye):
+    if abs(x0) >= 1.0:
+        return 0.0
+    ym = math.sqrt(max(0.0, (1.0 - x0 * x0) / 4.0))
+    return max(0.0, min(ye, ym) - max(ys, -ym))
+
+
+def seg_len_horizontal_scalar(y0, xs, xe):
+    if abs(2.0 * y0) >= 1.0:
+        return 0.0
+    xm = math.sqrt(max(0.0, 1.0 - 4.0 * y0 * y0))
+    return max(0.0, min(xe, xm) - max(xs, -xm))
+
+
+def test_membership_basic():
+    assert bool(ellipse.is_in_d(jnp.float64(0.0), jnp.float64(0.0)))
+    assert not bool(ellipse.is_in_d(jnp.float64(1.0), jnp.float64(0.0)))
+    assert not bool(ellipse.is_in_d(jnp.float64(0.0), jnp.float64(0.5)))
+    assert bool(ellipse.is_in_d(jnp.float64(0.9), jnp.float64(0.0)))
+
+
+def test_segment_lengths_match_closed_form():
+    rng = np.random.default_rng(0)
+    const = rng.uniform(-1.3, 1.3, size=200)
+    starts = rng.uniform(-1.3, 1.3, size=200)
+    lens = rng.uniform(0.0, 0.7, size=200)
+    ends = starts + lens
+
+    got_v = np.asarray(
+        ellipse.segment_length_vertical(
+            jnp.asarray(const), jnp.asarray(starts), jnp.asarray(ends)
+        )
+    )
+    got_h = np.asarray(
+        ellipse.segment_length_horizontal(
+            jnp.asarray(const), jnp.asarray(starts), jnp.asarray(ends)
+        )
+    )
+    want_v = [seg_len_vertical_scalar(c, s, e) for c, s, e in zip(const, starts, ends)]
+    want_h = [
+        seg_len_horizontal_scalar(c, s, e) for c, s, e in zip(const, starts, ends)
+    ]
+    np.testing.assert_allclose(got_v, want_v, rtol=0, atol=1e-14)
+    np.testing.assert_allclose(got_h, want_h, rtol=0, atol=1e-14)
+
+
+def test_segment_length_bounds():
+    rng = np.random.default_rng(1)
+    const = rng.uniform(-1.5, 1.5, size=500)
+    starts = rng.uniform(-1.5, 1.5, size=500)
+    ends = starts + rng.uniform(0.0, 1.0, size=500)
+    for fn in (ellipse.segment_length_vertical, ellipse.segment_length_horizontal):
+        lengths = np.asarray(fn(jnp.asarray(const), jnp.asarray(starts), jnp.asarray(ends)))
+        assert (lengths >= 0).all()
+        assert (lengths <= (ends - starts) + 1e-15).all()
+
+
+def test_analytic_solution_zero_on_boundary():
+    theta = np.linspace(0, 2 * np.pi, 64)
+    x, y = np.cos(theta), 0.5 * np.sin(theta)
+    vals = np.asarray(ellipse.analytic_solution(jnp.asarray(x), jnp.asarray(y)))
+    np.testing.assert_allclose(vals, 0.0, atol=1e-15)
+
+
+def test_analytic_solution_satisfies_pde():
+    # -Δu = 1 for u = (1 - x² - 4y²)/10: u_xx = -0.2, u_yy = -0.8.
+    x = jnp.asarray([0.1, -0.3])
+    y = jnp.asarray([0.05, 0.2])
+    h = 1e-5
+    u = ellipse.analytic_solution
+    lap = (
+        u(x + h, y) + u(x - h, y) - 2 * u(x, y)
+    ) / h**2 + (u(x, y + h) + u(x, y - h) - 2 * u(x, y)) / h**2
+    np.testing.assert_allclose(np.asarray(-lap), 1.0, rtol=1e-4)

@@ -1,0 +1,135 @@
+"""Operator (L3) tests: stencil vs dense matrix, SPD properties, block/global
+consistency, preconditioner guard."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.reduction import grid_dot
+from poisson_ellipse_tpu.ops.stencil import (
+    apply_a,
+    apply_a_block,
+    apply_dinv,
+    diag_d,
+    diag_d_block,
+)
+
+
+def dense_operator(problem, a, b):
+    """Build A as a dense matrix over interior nodes by applying the stencil
+    definition row by row (independent of the vectorised implementation)."""
+    M, N = problem.M, problem.N
+    h1, h2 = problem.h1, problem.h2
+    a = np.asarray(a)
+    b = np.asarray(b)
+    n_int = (M - 1) * (N - 1)
+    A = np.zeros((n_int, n_int))
+
+    def idx(i, j):
+        return (i - 1) * (N - 1) + (j - 1)
+
+    for i in range(1, M):
+        for j in range(1, N):
+            row = idx(i, j)
+            A[row, row] += (a[i + 1, j] + a[i, j]) / h1**2 + (
+                b[i, j + 1] + b[i, j]
+            ) / h2**2
+            if i + 1 <= M - 1:
+                A[row, idx(i + 1, j)] -= a[i + 1, j] / h1**2
+            if i - 1 >= 1:
+                A[row, idx(i - 1, j)] -= a[i, j] / h1**2
+            if j + 1 <= N - 1:
+                A[row, idx(i, j + 1)] -= b[i, j + 1] / h2**2
+            if j - 1 >= 1:
+                A[row, idx(i, j - 1)] -= b[i, j] / h2**2
+    return A
+
+
+@pytest.fixture(scope="module")
+def small_problem():
+    problem = Problem(M=10, N=12)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    return problem, a, b, rhs
+
+
+def test_stencil_matches_dense_matrix(small_problem):
+    problem, a, b, _ = small_problem
+    M, N = problem.M, problem.N
+    rng = np.random.default_rng(2)
+    w = np.zeros((M + 1, N + 1))
+    w[1:M, 1:N] = rng.standard_normal((M - 1, N - 1))
+    got = np.asarray(apply_a(jnp.asarray(w), a, b, problem.h1, problem.h2))
+    A = dense_operator(problem, a, b)
+    want = (A @ w[1:M, 1:N].ravel()).reshape(M - 1, N - 1)
+    np.testing.assert_allclose(got[1:M, 1:N], want, rtol=1e-10, atol=1e-8)
+    # boundary ring untouched
+    assert got[0].max() == 0 and got[-1].max() == 0
+    assert got[:, 0].max() == 0 and got[:, -1].max() == 0
+
+
+def test_operator_is_symmetric_positive_definite(small_problem):
+    problem, a, b, _ = small_problem
+    M, N = problem.M, problem.N
+    rng = np.random.default_rng(3)
+    h1, h2 = problem.h1, problem.h2
+    for _ in range(5):
+        u = np.zeros((M + 1, N + 1))
+        v = np.zeros((M + 1, N + 1))
+        u[1:M, 1:N] = rng.standard_normal((M - 1, N - 1))
+        v[1:M, 1:N] = rng.standard_normal((M - 1, N - 1))
+        u_j, v_j = jnp.asarray(u), jnp.asarray(v)
+        au = apply_a(u_j, a, b, h1, h2)
+        av = apply_a(v_j, a, b, h1, h2)
+        lhs = float(grid_dot(au, v_j, h1, h2))
+        rhs = float(grid_dot(u_j, av, h1, h2))
+        assert lhs == pytest.approx(rhs, rel=1e-10)
+        quad = float(grid_dot(au, u_j, h1, h2))
+        assert quad > 0.0
+
+
+def test_diag_matches_dense_diagonal(small_problem):
+    problem, a, b, _ = small_problem
+    M, N = problem.M, problem.N
+    d = np.asarray(diag_d(a, b, problem.h1, problem.h2))
+    A = dense_operator(problem, a, b)
+    np.testing.assert_allclose(
+        d[1:M, 1:N].ravel(), np.diag(A), rtol=1e-12, atol=0
+    )
+
+
+def test_block_ops_match_global(small_problem):
+    problem, a, b, _ = small_problem
+    M, N = problem.M, problem.N
+    rng = np.random.default_rng(4)
+    w = np.zeros((M + 1, N + 1))
+    w[1:M, 1:N] = rng.standard_normal((M - 1, N - 1))
+    w_j = jnp.asarray(w)
+    h1, h2 = problem.h1, problem.h2
+    full = np.asarray(apply_a(w_j, a, b, h1, h2))
+    # treat global rows 3..7, cols 2..9 as one device's owned block
+    i0, i1, j0, j1 = 3, 8, 2, 10
+    blk = apply_a_block(
+        w_j[i0 - 1 : i1 + 1, j0 - 1 : j1 + 1],
+        a[i0 - 1 : i1 + 1, j0 - 1 : j1 + 1],
+        b[i0 - 1 : i1 + 1, j0 - 1 : j1 + 1],
+        h1,
+        h2,
+    )
+    np.testing.assert_allclose(np.asarray(blk), full[i0:i1, j0:j1], rtol=1e-12)
+    d_full = np.asarray(diag_d(a, b, h1, h2))
+    d_blk = diag_d_block(
+        a[i0 - 1 : i1 + 1, j0 - 1 : j1 + 1],
+        b[i0 - 1 : i1 + 1, j0 - 1 : j1 + 1],
+        h1,
+        h2,
+    )
+    np.testing.assert_allclose(np.asarray(d_blk), d_full[i0:i1, j0:j1], rtol=1e-12)
+
+
+def test_apply_dinv_zero_guard():
+    d = jnp.asarray([[0.0, 2.0], [4.0, 0.0]])
+    r = jnp.asarray([[1.0, 1.0], [1.0, 1.0]])
+    z = np.asarray(apply_dinv(r, d))
+    np.testing.assert_allclose(z, [[0.0, 0.5], [0.25, 0.0]])

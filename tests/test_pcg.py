@@ -1,0 +1,106 @@
+"""Solver (L5) integration tests against the committed reference code's
+iteration-count oracles and the analytic solution.
+
+Oracle provenance: the reference's stage0 binary (compiled from
+stage0/Withoutopenmp1.cpp, unweighted norm) prints 17/31/61 iterations at
+10²/20²/40²; the stage1 binary (weighted norm, stages 1-4 convention,
+Withopenmp1.cpp:182-189) prints 50 at 40². The stage-report PDFs quote 60
+at 40² — that figure predates the committed code; the committed code is the
+oracle here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import pcg, solve
+from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic, residual_norm
+
+UNWEIGHTED_ORACLE = {(10, 10): 17, (20, 20): 31, (40, 40): 61}
+WEIGHTED_ORACLE = {(10, 10): 15, (20, 20): 26, (40, 40): 50}
+
+
+@pytest.mark.parametrize("M,N", sorted(UNWEIGHTED_ORACLE))
+def test_iteration_counts_unweighted_stage0(M, N):
+    problem = Problem(M=M, N=N, norm="unweighted")
+    result = solve(problem, jnp.float64)
+    assert int(result.iters) == UNWEIGHTED_ORACLE[(M, N)]
+    assert bool(result.converged)
+    assert not bool(result.breakdown)
+
+
+@pytest.mark.parametrize("M,N", sorted(WEIGHTED_ORACLE))
+def test_iteration_counts_weighted_stages1to4(M, N):
+    problem = Problem(M=M, N=N, norm="weighted")
+    result = solve(problem, jnp.float64)
+    assert int(result.iters) == WEIGHTED_ORACLE[(M, N)]
+    assert bool(result.converged)
+
+
+@pytest.mark.parametrize(
+    "M,N,expected_l2",
+    [(10, 10, 5.604e-3), (20, 20, 7.663e-3), (40, 40, 3.677e-3)],
+)
+def test_l2_error_vs_analytic(M, N, expected_l2):
+    problem = Problem(M=M, N=N, norm="unweighted")
+    result = solve(problem, jnp.float64)
+    err = float(l2_error_vs_analytic(problem, result.w))
+    assert err == pytest.approx(expected_l2, rel=1e-3)
+
+
+def test_solution_residual_small():
+    problem = Problem(M=40, N=40)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    result = pcg(problem, a, b, rhs)
+    res = float(residual_norm(problem, result.w, a, b, rhs))
+    rhs_norm = float(jnp.sqrt(jnp.sum(rhs**2) * problem.h1 * problem.h2))
+    # stopping rule is on ‖Δw‖, not the residual; the stiff 1/eps coefficients
+    # leave a larger (but still small) relative residual at delta=1e-6
+    assert res / rhs_norm < 1e-2
+
+
+def test_pcg_jits_cleanly():
+    problem = Problem(M=20, N=20)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    jitted = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))
+    r1 = jitted(a, b, rhs)
+    r2 = solve(problem, jnp.float64)
+    assert int(r1.iters) == int(r2.iters)
+    # jit fuses differently from op-by-op dispatch → last-ulp differences only
+    np.testing.assert_allclose(
+        np.asarray(r1.w), np.asarray(r2.w), rtol=1e-12, atol=1e-15
+    )
+
+
+def test_max_iter_cap_respected():
+    problem = Problem(M=40, N=40, max_iter=5)
+    result = solve(problem, jnp.float64)
+    assert int(result.iters) == 5
+    assert not bool(result.converged)
+
+
+def test_l2_error_decreases_under_refinement():
+    # fictitious-domain convergence: error at 80² well below error at 20²
+    e20 = float(
+        l2_error_vs_analytic(
+            Problem(M=20, N=20), solve(Problem(M=20, N=20), jnp.float64).w
+        )
+    )
+    e80 = float(
+        l2_error_vs_analytic(
+            Problem(M=80, N=80), solve(Problem(M=80, N=80), jnp.float64).w
+        )
+    )
+    assert e80 < e20
+
+
+def test_float32_path_converges():
+    problem = Problem(M=40, N=40, delta=1e-4)
+    result = solve(problem, jnp.float32)
+    assert result.w.dtype == jnp.float32
+    assert bool(result.converged)
+    err = float(l2_error_vs_analytic(problem, result.w))
+    assert err < 5e-3
