@@ -6,8 +6,12 @@ inside OpenMP/CUDA loops (``stage0/Withoutopenmp1.cpp:14-16`` membership,
 are written as broadcast ``jnp`` expressions over whole coordinate arrays —
 one fused XLA kernel assembles the entire grid, no loops.
 
-All branches become ``jnp.where``; square roots are clamped at zero before
+All branches become ``where``; square roots are clamped at zero before
 evaluation so the gradients/values are well-defined everywhere.
+
+Every function takes an ``xp`` array-module argument (default ``jax.numpy``)
+so the *same* closed forms serve both the traced on-device path and the
+float64 host-assembly path (``xp=numpy``) — the geometry exists exactly once.
 """
 
 from __future__ import annotations
@@ -32,27 +36,27 @@ def analytic_solution(x, y):
     return (1.0 - x * x - 4.0 * y * y) / 10.0
 
 
-def segment_length_vertical(x0, y_start, y_end):
+def segment_length_vertical(x0, y_start, y_end, xp=jnp):
     """Length of {x0} × [y_start, y_end] ∩ D.
 
     Closed form: for |x0| < 1 the ellipse spans |y| ≤ sqrt((1-x0²)/4).
     Reference: ``stage0/Withoutopenmp1.cpp:21-28`` (is_ver branch).
     """
-    y_max = jnp.sqrt(jnp.maximum(0.0, (1.0 - x0 * x0) / 4.0))
-    length = jnp.maximum(
-        0.0, jnp.minimum(y_end, y_max) - jnp.maximum(y_start, -y_max)
+    y_max = xp.sqrt(xp.maximum(0.0, (1.0 - x0 * x0) / 4.0))
+    length = xp.maximum(
+        0.0, xp.minimum(y_end, y_max) - xp.maximum(y_start, -y_max)
     )
-    return jnp.where(jnp.abs(x0) >= 1.0, 0.0, length)
+    return xp.where(xp.abs(x0) >= 1.0, 0.0, length)
 
 
-def segment_length_horizontal(y0, x_start, x_end):
+def segment_length_horizontal(y0, x_start, x_end, xp=jnp):
     """Length of [x_start, x_end] × {y0} ∩ D.
 
     Closed form: for |2·y0| < 1 the ellipse spans |x| ≤ sqrt(1-4y0²).
     Reference: ``stage0/Withoutopenmp1.cpp:29-37`` (horizontal branch).
     """
-    x_max = jnp.sqrt(jnp.maximum(0.0, 1.0 - 4.0 * y0 * y0))
-    length = jnp.maximum(
-        0.0, jnp.minimum(x_end, x_max) - jnp.maximum(x_start, -x_max)
+    x_max = xp.sqrt(xp.maximum(0.0, 1.0 - 4.0 * y0 * y0))
+    length = xp.maximum(
+        0.0, xp.minimum(x_end, x_max) - xp.maximum(x_start, -x_max)
     )
-    return jnp.where(jnp.abs(2.0 * y0) >= 1.0, 0.0, length)
+    return xp.where(xp.abs(2.0 * y0) >= 1.0, 0.0, length)

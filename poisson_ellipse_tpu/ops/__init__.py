@@ -6,7 +6,9 @@ from poisson_ellipse_tpu.ops.assembly import (
     coefficients_at,
     rhs_at,
     assemble,
+    assemble_numpy,
     assemble_on_device,
+    numpy_dtype,
 )
 from poisson_ellipse_tpu.ops.stencil import (
     apply_a,
@@ -21,7 +23,9 @@ __all__ = [
     "coefficients_at",
     "rhs_at",
     "assemble",
+    "assemble_numpy",
     "assemble_on_device",
+    "numpy_dtype",
     "apply_a",
     "apply_a_block",
     "diag_d",

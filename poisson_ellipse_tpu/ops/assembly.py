@@ -34,15 +34,35 @@ _FULL_TOL = 1e-9
 _EMPTY_TOL = 1e-9
 
 
-def _blend(length, h, eps):
+def _blend(length, h, eps, xp=jnp):
     """Piecewise coefficient law for one face of length-in-D ``length``."""
     frac = length / h
     cut = frac + (1.0 - frac) / eps
-    return jnp.where(
-        jnp.abs(length - h) < _FULL_TOL,
+    return xp.where(
+        xp.abs(length - h) < _FULL_TOL,
         1.0,
-        jnp.where(length < _EMPTY_TOL, 1.0 / eps, cut),
+        xp.where(length < _EMPTY_TOL, 1.0 / eps, cut),
     )
+
+
+def _coefficients_xp(problem: Problem, x, y, xp):
+    """Shared closed-form coefficient evaluation at node coordinates x × y.
+
+    The single source of truth for the blend law applied to the segment
+    closed forms; serves both the traced path (xp=jnp) and the float64
+    host path (xp=numpy).
+    """
+    h1, h2 = problem.h1, problem.h2
+    eps = problem.eps_value
+    xc = x[:, None]
+    yc = y[None, :]
+    la = ellipse.segment_length_vertical(
+        xc - 0.5 * h1, yc - 0.5 * h2, yc + 0.5 * h2, xp
+    )
+    lb = ellipse.segment_length_horizontal(
+        yc - 0.5 * h2, xc - 0.5 * h1, xc + 0.5 * h1, xp
+    )
+    return _blend(la, h2, eps, xp), _blend(lb, h1, eps, xp)
 
 
 def coefficients_at(problem: Problem, gi, gj, dtype=jnp.float32):
@@ -56,17 +76,9 @@ def coefficients_at(problem: Problem, gi, gj, dtype=jnp.float32):
     """
     gi = jnp.asarray(gi)
     gj = jnp.asarray(gj)
-    h1 = jnp.asarray(problem.h1, dtype)
-    h2 = jnp.asarray(problem.h2, dtype)
-    eps = jnp.asarray(problem.eps_value, dtype)
-    x = problem.a1 + gi.astype(dtype) * h1
-    y = problem.a2 + gj.astype(dtype) * h2
-    xc = x[:, None]
-    yc = y[None, :]
-    la = ellipse.segment_length_vertical(xc - 0.5 * h1, yc - 0.5 * h2, yc + 0.5 * h2)
-    lb = ellipse.segment_length_horizontal(yc - 0.5 * h2, xc - 0.5 * h1, xc + 0.5 * h1)
-    a = _blend(la, h2, eps)
-    b = _blend(lb, h1, eps)
+    x = problem.a1 + gi.astype(dtype) * jnp.asarray(problem.h1, dtype)
+    y = problem.a2 + gj.astype(dtype) * jnp.asarray(problem.h2, dtype)
+    a, b = _coefficients_xp(problem, x, y, jnp)
     valid = (
         ((gi >= 1) & (gi <= problem.M))[:, None]
         & ((gj >= 1) & (gj <= problem.N))[None, :]
@@ -102,7 +114,7 @@ def interior_mask(problem: Problem, gi, gj):
     )
 
 
-def _assemble_numpy_f64(problem: Problem):
+def assemble_numpy(problem: Problem):
     """Full-precision host assembly in vectorised numpy float64.
 
     The geometry MUST be evaluated in f64 regardless of the solve dtype:
@@ -113,44 +125,23 @@ def _assemble_numpy_f64(problem: Problem):
     Evaluating in f64 and *then* casting keeps coefficients exact to the
     target dtype's resolution. This mirrors the reference, which always
     assembles on the host in double (``poisson_mpi_cuda2.cu:146-192``).
+
+    Public API: the sharded solver pads/casts/lays these arrays out over
+    the mesh. Uses the same closed forms as the traced path via
+    ``_coefficients_xp(…, xp=numpy)``.
     """
     M, N = problem.M, problem.N
-    h1, h2 = problem.h1, problem.h2
-    eps = problem.eps_value
     gi = np.arange(M + 1, dtype=np.float64)
     gj = np.arange(N + 1, dtype=np.float64)
-    x = problem.a1 + gi * h1
-    y = problem.a2 + gj * h2
-    xc = x[:, None]
-    yc = y[None, :]
-
-    # segment ∩ ellipse closed forms (stage0/Withoutopenmp1.cpp:19-39)
-    x0 = xc - 0.5 * h1
-    y_max = np.sqrt(np.maximum(0.0, (1.0 - x0 * x0) / 4.0))
-    la = np.maximum(
-        0.0, np.minimum(yc + 0.5 * h2, y_max) - np.maximum(yc - 0.5 * h2, -y_max)
-    )
-    la = np.where(np.abs(x0) >= 1.0, 0.0, la)
-    y0 = yc - 0.5 * h2
-    x_max = np.sqrt(np.maximum(0.0, 1.0 - 4.0 * y0 * y0))
-    lb = np.maximum(
-        0.0, np.minimum(xc + 0.5 * h1, x_max) - np.maximum(xc - 0.5 * h1, -x_max)
-    )
-    lb = np.where(np.abs(2.0 * y0) >= 1.0, 0.0, lb)
-
-    def blend(length, h):
-        frac = length / h
-        return np.where(
-            np.abs(length - h) < _FULL_TOL,
-            1.0,
-            np.where(length < _EMPTY_TOL, 1.0 / eps, frac + (1.0 - frac) / eps),
-        )
+    x = problem.a1 + gi * problem.h1
+    y = problem.a2 + gj * problem.h2
+    a, b = _coefficients_xp(problem, x, y, np)
 
     valid = ((gi >= 1) & (gi <= M))[:, None] & ((gj >= 1) & (gj <= N))[None, :]
-    a = np.where(valid, blend(la, h2), 0.0)
-    b = np.where(valid, blend(lb, h1), 0.0)
+    a = np.where(valid, a, 0.0)
+    b = np.where(valid, b, 0.0)
 
-    inside = xc * xc + 4.0 * yc * yc < 1.0
+    inside = ellipse.is_in_d(x[:, None], y[None, :])
     interior = ((gi >= 1) & (gi <= M - 1))[:, None] & (
         (gj >= 1) & (gj <= N - 1)
     )[None, :]
@@ -161,22 +152,23 @@ def _assemble_numpy_f64(problem: Problem):
 def assemble(problem: Problem, dtype=jnp.float32):
     """Assemble the full global (a, b, rhs) node-grid arrays, shape (M+1, N+1).
 
-    Geometry is evaluated on the host in float64 (see ``_assemble_numpy_f64``
+    Geometry is evaluated on the host in float64 (see ``assemble_numpy``
     for why this is mandatory) and cast to ``dtype`` — a one-time setup cost,
     exactly as the reference assembles on the CPU host before uploading
     (``poisson_mpi_cuda2.cu:716-759``). Row/col 0 of a,b are zero, matching
     the reference's (M+1)×(N+1) zero-initialised vectors
     (``stage0/Withoutopenmp1.cpp:111-112``).
     """
-    a, b, rhs = _assemble_numpy_f64(problem)
+    a, b, rhs = assemble_numpy(problem)
     return (
-        jnp.asarray(a.astype(_np_dtype(dtype))),
-        jnp.asarray(b.astype(_np_dtype(dtype))),
-        jnp.asarray(rhs.astype(_np_dtype(dtype))),
+        jnp.asarray(a.astype(numpy_dtype(dtype))),
+        jnp.asarray(b.astype(numpy_dtype(dtype))),
+        jnp.asarray(rhs.astype(numpy_dtype(dtype))),
     )
 
 
-def _np_dtype(dtype):
+def numpy_dtype(dtype):
+    """The numpy dtype corresponding to a jax dtype spec."""
     return np.dtype(jnp.dtype(dtype).name)
 
 
@@ -184,7 +176,7 @@ def assemble_on_device(problem: Problem, dtype=jnp.float32):
     """Assemble the full grid with traced jnp ops (no host work).
 
     Only use where the trace dtype is f64 (e.g. the CPU-mesh distributed
-    tests with x64 enabled) or on coarse grids — see ``_assemble_numpy_f64``
+    tests with x64 enabled) or on coarse grids — see ``assemble_numpy``
     for the f32 precision hazard.
     """
     gi = jnp.arange(problem.M + 1)

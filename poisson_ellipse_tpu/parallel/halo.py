@@ -1,0 +1,54 @@
+"""1-cell halo-ring exchange over the device mesh (reference layer L4).
+
+TPU-native replacement for the reference's halo machinery: where stage2
+packs first/last interior rows+columns into staging buffers and posts
+``MPI_Irecv/Isend`` (``stage2-mpi/poisson_mpi_decomp.cpp:241-347``) and
+stage4 additionally stages every halo through the host with
+``cudaMemcpy``/``cudaMemcpy2D`` around blocking ``MPI_Sendrecv``
+(``poisson_mpi_cuda2.cu:331-500``), here each direction is a single
+``lax.ppermute`` of a boundary slice over ICI — device-to-device, no
+packing, no host.
+
+Design facts carried over from the reference (SURVEY §5):
+- corners ride along: the y-direction exchange operates on the already
+  x-extended block, so corner cells propagate in one round
+  (``stage2:263-280``),
+- missing neighbours (physical boundary, and here also mesh-padding edges)
+  receive zeros — exactly the Dirichlet substitution of
+  ``stage2:288-324``: ``lax.ppermute`` leaves non-receiving devices with
+  zeros by construction, so the boundary condition costs nothing.
+
+Must be called inside ``shard_map`` over a mesh with axes ('x', 'y').
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y
+
+
+def _shift_lo_to_hi(edge, axis_name: str, n: int):
+    """Send each device's high edge to its successor; first device gets 0."""
+    return lax.ppermute(edge, axis_name, [(i, i + 1) for i in range(n - 1)])
+
+
+def _shift_hi_to_lo(edge, axis_name: str, n: int):
+    """Send each device's low edge to its predecessor; last device gets 0."""
+    return lax.ppermute(edge, axis_name, [(i + 1, i) for i in range(n - 1)])
+
+
+def halo_extend(u, px: int, py: int):
+    """Extend a local (bm, bn) block to (bm+2, bn+2) with neighbour halos.
+
+    Zeros appear wherever there is no neighbour (Dirichlet boundary /
+    padding). One x-round then one y-round on the extended block, so the
+    four corner cells are correct after two rounds.
+    """
+    lo_x = _shift_lo_to_hi(u[-1:, :], AXIS_X, px)
+    hi_x = _shift_hi_to_lo(u[:1, :], AXIS_X, px)
+    u = jnp.concatenate([lo_x, u, hi_x], axis=0)
+    lo_y = _shift_lo_to_hi(u[:, -1:], AXIS_Y, py)
+    hi_y = _shift_hi_to_lo(u[:, :1], AXIS_Y, py)
+    return jnp.concatenate([lo_y, u, hi_y], axis=1)

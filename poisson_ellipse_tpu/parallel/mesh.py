@@ -1,0 +1,52 @@
+"""Device-mesh construction (reference layer L2: process-grid + partition).
+
+``choose_process_grid`` reproduces the reference's factorisation exactly
+(``stage2-mpi/poisson_mpi_decomp.cpp:60-64``): Px = ⌊√size⌋ decremented to
+the nearest divisor, Py = size/Px — a near-square grid with Px ≤ Py.
+
+Where ``decompose_2d`` (``:75-111``) hands out blocks differing by ≤1 row
+to low ranks, XLA sharding wants equal shards: we instead zero-pad the
+global node grid up to a multiple of the mesh shape. The padding carries
+zero coefficients and a zero RHS, so padded nodes behave exactly like the
+exterior Dirichlet ring and never influence the interior solve.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_X = "x"
+AXIS_Y = "y"
+
+
+def choose_process_grid(size: int) -> tuple[int, int]:
+    """Factor ``size`` devices into a near-square (px, py), px ≤ py.
+
+    Reference: ``stage2-mpi/poisson_mpi_decomp.cpp:60-64``.
+    """
+    if size < 1:
+        raise ValueError("need at least one device")
+    px = int(math.isqrt(size))
+    while size % px:
+        px -= 1
+    return px, size // px
+
+
+def make_mesh(devices=None) -> Mesh:
+    """Build a 2D ('x', 'y') mesh over the given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    px, py = choose_process_grid(len(devices))
+    return Mesh(np.asarray(devices).reshape(px, py), (AXIS_X, AXIS_Y))
+
+
+def padded_dims(problem_nodes: tuple[int, int], mesh: Mesh) -> tuple[int, int]:
+    """Global node-grid dims padded up to multiples of the mesh shape."""
+    g1, g2 = problem_nodes
+    px = mesh.shape[AXIS_X]
+    py = mesh.shape[AXIS_Y]
+    return (-(-g1 // px) * px, -(-g2 // py) * py)

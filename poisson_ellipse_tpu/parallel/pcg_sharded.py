@@ -1,0 +1,237 @@
+"""Distributed PCG: the whole solve as one shard_map-ped on-device program.
+
+TPU-native redesign of the reference's distributed drivers (``solve_mpi``,
+``stage2-mpi/poisson_mpi_decomp.cpp:356-460``; ``gradient_solver_mpi``,
+``stage4-mpi+cuda/poisson_mpi_cuda2.cu:687-982``). Structural comparison,
+per PCG iteration:
+
+  reference stage4 (per iteration)          here (per iteration)
+  ---------------------------------------   ---------------------------------
+  4× (D2H memcpy → MPI_Sendrecv → H2D)      1 halo_extend = 4 lax.ppermute
+  3× (dot kernel → D2H 256KiB partials      2 lax.psum collectives (denom;
+      → host sum → MPI_Allreduce)              [zr, ‖Δw‖²] batched as one)
+  α/β/convergence on host                   α/β/convergence on device in
+  6 kernel launches + 6 device syncs          lax.while_loop — zero host
+                                              round-trips, zero syncs
+
+The decomposition itself (``choose_process_grid`` + ``decompose_2d``)
+becomes a ``Mesh`` + zero-padding to even shards (see ``parallel.mesh``);
+per-rank local assembly with a halo ring (``fictitious_regions_setup_local``,
+``poisson_mpi_cuda2.cu:146-192``) is available as ``assembly_mode="device"``
+— each device assembles its own halo-extended coefficient block from global
+indices with no communication at all, exactly the reference's contract.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.stencil import apply_a_block, apply_dinv, diag_d_block
+from poisson_ellipse_tpu.parallel.halo import halo_extend
+from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh, padded_dims
+from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
+
+
+def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
+               a_ext, b_ext, rhs_blk, dtype):
+    """Per-device PCG body. Runs inside shard_map; a_ext/b_ext are the
+    device's halo-extended (bm+2, bn+2) coefficient blocks, rhs_blk its
+    owned (bm, bn) RHS block."""
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    delta = jnp.asarray(problem.delta, dtype)
+    weighted = problem.norm == "weighted"
+
+    ix = lax.axis_index(AXIS_X)
+    iy = lax.axis_index(AXIS_Y)
+    gi = ix * bm + jnp.arange(bm, dtype=jnp.int32)
+    gj = iy * bn + jnp.arange(bn, dtype=jnp.int32)
+    interior = (
+        ((gi >= 1) & (gi <= problem.M - 1))[:, None]
+        & ((gj >= 1) & (gj <= problem.N - 1))[None, :]
+    )
+
+    # Diagonal, zeroed outside the global interior so apply_dinv's guard
+    # keeps every iterate exactly zero there (boundary ring + shard padding).
+    d = jnp.where(interior, diag_d_block(a_ext, b_ext, h1, h2), 0.0)
+    maskd = interior.astype(dtype)
+
+    def stencil(p):
+        p_ext = halo_extend(p, px, py)
+        return apply_a_block(p_ext, a_ext, b_ext, h1, h2) * maskd
+
+    def pdot(u, v):
+        return lax.psum(jnp.sum(u * v), (AXIS_X, AXIS_Y)) * h1 * h2
+
+    # the zeros literal is device-invariant; mark it varying over the mesh so
+    # the while_loop carry type matches the (varying) per-device updates
+    w0 = lax.pcast(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y), to="varying")
+    r0 = rhs_blk
+    z0 = apply_dinv(r0, d)
+    p0 = z0
+    zr0 = pdot(z0, r0)
+
+    def cond(state):
+        k, _w, _r, _p, _zr, _diff, converged, breakdown = state
+        return (k < problem.max_iterations) & ~converged & ~breakdown
+
+    def body(state):
+        k, w, r, p, zr, _diff, _c, _bd = state
+        ap = stencil(p)
+        denom = pdot(ap, p)
+        breakdown = denom < DENOM_GUARD
+        alpha = zr / jnp.where(breakdown, 1.0, denom)
+
+        w_new = w + alpha * p
+        r_new = r - alpha * ap
+        z = apply_dinv(r_new, d)
+
+        # one collective for both scalars (vs 2 of the reference's 3
+        # Allreduces; the denominator one above is inherently sequential)
+        dw = w_new - w
+        partial_sums = jnp.stack([jnp.sum(z * r_new), jnp.sum(dw * dw)])
+        zr_sum, dw2 = lax.psum(partial_sums, (AXIS_X, AXIS_Y))
+        zr_new = zr_sum * h1 * h2
+        diff = jnp.sqrt(dw2 * h1 * h2) if weighted else jnp.sqrt(dw2)
+        converged = ~breakdown & (diff < delta)
+        diff = jnp.where(breakdown, _diff, diff)
+
+        beta = zr_new / zr
+        p_new = z + beta * p
+
+        w_out = jnp.where(breakdown, w, w_new)
+        r_out = jnp.where(breakdown, r, r_new)
+        p_out = jnp.where(breakdown | converged, p, p_new)
+        zr_out = jnp.where(breakdown | converged, zr, zr_new)
+        return (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
+
+    state0 = (
+        jnp.asarray(0, jnp.int32),
+        w0,
+        r0,
+        p0,
+        zr0,
+        jnp.asarray(jnp.inf, dtype),
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+    k, w, _r, _p, _zr, diff, converged, breakdown = lax.while_loop(
+        cond, body, state0
+    )
+    return w, k, diff, converged, breakdown
+
+
+def build_sharded_solver(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+    assembly_mode: str = "host",
+):
+    """Return (jitted solver_fn, args) for the mesh-sharded solve.
+
+    assembly_mode:
+      "host"   — coefficients assembled once on the host in f64, cast, and
+                 laid out over the mesh (args = the three sharded arrays;
+                 their one-time coefficient halos are exchanged on device).
+      "device" — every device assembles its own halo-extended block from
+                 global indices inside shard_map, zero communication
+                 (args = ()); use with f64 traces — see
+                 ``ops.assembly._assemble_numpy_f64`` for the f32 hazard.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    px = mesh.shape[AXIS_X]
+    py = mesh.shape[AXIS_Y]
+    g1p, g2p = padded_dims(problem.node_shape, mesh)
+    bm, bn = g1p // px, g2p // py
+    spec = P(AXIS_X, AXIS_Y)
+
+    if assembly_mode == "host":
+
+        def shard_fn(a_blk, b_blk, rhs_blk):
+            # one-time coefficient halo exchange (the reference avoids this
+            # by assembling a halo ring locally; both modes are provided)
+            a_ext = halo_extend(a_blk, px, py)
+            b_ext = halo_extend(b_blk, px, py)
+            return _local_pcg(
+                problem, px, py, bm, bn, a_ext, b_ext, rhs_blk, dtype
+            )
+
+        mapped = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, P(), P(), P(), P()),
+        )
+
+        a, b, rhs = assembly.assemble_numpy(problem)
+        np_dtype = assembly.numpy_dtype(dtype)
+        sharding = NamedSharding(mesh, spec)
+        args = tuple(
+            jax.device_put(
+                _pad_to(arr, g1p, g2p).astype(np_dtype), sharding
+            )
+            for arr in (a, b, rhs)
+        )
+    elif assembly_mode == "device":
+
+        def shard_fn():
+            ix = lax.axis_index(AXIS_X)
+            iy = lax.axis_index(AXIS_Y)
+            gi_ext = ix * bm - 1 + jnp.arange(bm + 2, dtype=jnp.int32)
+            gj_ext = iy * bn - 1 + jnp.arange(bn + 2, dtype=jnp.int32)
+            a_ext, b_ext = assembly.coefficients_at(problem, gi_ext, gj_ext, dtype)
+            rhs_blk = assembly.rhs_at(
+                problem, gi_ext[1:-1], gj_ext[1:-1], dtype
+            )
+            return _local_pcg(
+                problem, px, py, bm, bn, a_ext, b_ext, rhs_blk, dtype
+            )
+
+        mapped = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(),
+            out_specs=(spec, P(), P(), P(), P()),
+        )
+        args = ()
+    else:
+        raise ValueError(f"unknown assembly_mode: {assembly_mode!r}")
+
+    def solver(*arrays):
+        w_pad, k, diff, converged, breakdown = mapped(*arrays)
+        return PCGResult(
+            w=w_pad[: problem.M + 1, : problem.N + 1],
+            iters=k,
+            diff=diff,
+            converged=converged,
+            breakdown=breakdown,
+        )
+
+    return jax.jit(solver), args
+
+
+def solve_sharded(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+    assembly_mode: str = "host",
+) -> PCGResult:
+    """Assemble, shard and solve over the mesh (all devices by default)."""
+    solver, args = build_sharded_solver(problem, mesh, dtype, assembly_mode)
+    return solver(*args)
+
+
+def _pad_to(arr, g1p: int, g2p: int):
+    import numpy as np
+
+    out = np.zeros((g1p, g2p), dtype=arr.dtype)
+    out[: arr.shape[0], : arr.shape[1]] = arr
+    return out
