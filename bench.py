@@ -1,0 +1,98 @@
+"""Benchmark: T_solver on the reference's headline grids, single TPU chip.
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": T_solver_800x1200_s, "unit": "s", "vs_baseline": speedup}
+
+vs_baseline is the speedup over the reference's strongest published
+single-accelerator number on the same grid: stage4 MPI+CUDA, 1 rank /
+1×P100, 800×1200, T_solver = 0.83 s (Этап_4_1213.pdf table 1; BASELINE.md).
+Convergence (δ=1e-6, weighted norm) and the iteration-count oracles
+(546 @ 400×600, 989 @ 800×1200, 1858 @ 1600×2400, 2449 @ 2400×3200) are
+checked and reported on stderr; a mismatch marks the run invalid.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import pcg
+from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
+
+# (M, N, oracle_iters, reference stage4 1-GPU T_solver seconds or None)
+GRIDS = [
+    (400, 600, 546, None),
+    (800, 1200, 989, 0.83),
+    (1600, 2400, 1858, 4.85),
+    (2400, 3200, 2449, 13.24),
+]
+HEADLINE = (800, 1200)
+REPS = 3
+BATCH = 4
+
+
+def bench_grid(M: int, N: int, oracle: int):
+    problem = Problem(M=M, N=N)
+    a, b, rhs = assembly.assemble(problem, jnp.float32)
+    run = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))
+    result = run(a, b, rhs)  # compile + warm-up
+    float(result.diff)  # forced host transfer: the only reliable sync here
+    # Time BATCH back-to-back dispatches with one final scalar fetch as the
+    # sync point: single-stream in-order execution makes syncing the last
+    # result sufficient, and batching amortises the host↔device tunnel RTT
+    # (~0.1 s under axon), which would otherwise swamp the small grids.
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        for _ in range(BATCH):
+            result = run(a, b, rhs)
+        float(result.diff)
+        times.append((time.perf_counter() - t0) / BATCH)
+    t = statistics.median(times)
+    iters = int(result.iters)
+    err = float(l2_error_vs_analytic(problem, result.w))
+    ok = bool(result.converged) and iters == oracle
+    print(
+        f"  {M}x{N}: T_solver={t:.4f}s iters={iters} (oracle {oracle}) "
+        f"converged={bool(result.converged)} l2_err={err:.3e}",
+        file=sys.stderr,
+    )
+    return t, ok
+
+
+def main() -> int:
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    headline_t, baseline, all_ok = None, None, True
+    for M, N, oracle, ref_t in GRIDS:
+        t, ok = bench_grid(M, N, oracle)
+        all_ok &= ok
+        if ref_t is not None:
+            print(
+                f"    vs stage4 1-GPU P100 ({ref_t}s): {ref_t / t:.2f}x",
+                file=sys.stderr,
+            )
+        if (M, N) == HEADLINE:
+            headline_t, baseline = t, ref_t
+    print(
+        json.dumps(
+            {
+                "metric": "T_solver 800x1200 (989 PCG iters to 1e-6), f32, 1 chip",
+                "value": round(headline_t, 5),
+                "unit": "s",
+                "vs_baseline": round(baseline / headline_t, 2),
+                "valid": all_ok,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

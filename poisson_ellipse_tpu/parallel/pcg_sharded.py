@@ -24,10 +24,9 @@ indices with no communication at all, exactly the reference's contract.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -53,10 +52,7 @@ def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
     iy = lax.axis_index(AXIS_Y)
     gi = ix * bm + jnp.arange(bm, dtype=jnp.int32)
     gj = iy * bn + jnp.arange(bn, dtype=jnp.int32)
-    interior = (
-        ((gi >= 1) & (gi <= problem.M - 1))[:, None]
-        & ((gj >= 1) & (gj <= problem.N - 1))[None, :]
-    )
+    interior = assembly.interior_mask(problem, gi, gj)
 
     # Diagonal, zeroed outside the global interior so apply_dinv's guard
     # keeps every iterate exactly zero there (boundary ring + shard padding).
@@ -143,7 +139,7 @@ def build_sharded_solver(
       "device" — every device assembles its own halo-extended block from
                  global indices inside shard_map, zero communication
                  (args = ()); use with f64 traces — see
-                 ``ops.assembly._assemble_numpy_f64`` for the f32 hazard.
+                 ``ops.assembly.assemble_numpy`` for the f32 hazard.
     """
     if mesh is None:
         mesh = make_mesh()
@@ -230,8 +226,6 @@ def solve_sharded(
 
 
 def _pad_to(arr, g1p: int, g2p: int):
-    import numpy as np
-
-    out = np.zeros((g1p, g2p), dtype=arr.dtype)
-    out[: arr.shape[0], : arr.shape[1]] = arr
-    return out
+    return np.pad(
+        arr, ((0, g1p - arr.shape[0]), (0, g2p - arr.shape[1]))
+    )

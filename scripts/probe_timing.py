@@ -1,0 +1,32 @@
+"""Probe timing semantics under axon: block_until_ready vs host transfer."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import pcg
+
+for (M, N) in [(400, 600), (800, 1200)]:
+    prob = Problem(M=M, N=N)
+    a, b, rhs = assembly.assemble(prob, jnp.float32)
+    run = jax.jit(lambda a, b, rhs, p=prob: pcg(p, a, b, rhs))
+    r = run(a, b, rhs)
+    jax.block_until_ready(r)
+    for rep in range(4):
+        t0 = time.perf_counter()
+        r = run(a, b, rhs)
+        jax.block_until_ready(r)
+        t1 = time.perf_counter()
+        it = int(r.iters)  # forced host transfer
+        t2 = time.perf_counter()
+        w_host = np.asarray(r.w)
+        t3 = time.perf_counter()
+        print(
+            f"{M}x{N} rep{rep}: block={t1-t0:.4f}s +scalar={t2-t1:.4f}s "
+            f"+w_to_host={t3-t2:.4f}s iters={it}",
+            file=sys.stderr,
+        )

@@ -1,0 +1,34 @@
+"""Probe: f32 single-chip solve on real TPU — iters, L2 error, timing."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import pcg
+from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
+
+print("devices:", jax.devices(), file=sys.stderr)
+
+for (M, N) in [(40, 40), (400, 600), (800, 1200)]:
+    prob = Problem(M=M, N=N)
+    t0 = time.perf_counter()
+    a, b, rhs = assembly.assemble(prob, jnp.float32)
+    t1 = time.perf_counter()
+    run = jax.jit(lambda a, b, rhs, p=prob: pcg(p, a, b, rhs))
+    res = run(a, b, rhs)
+    res.w.block_until_ready()
+    t2 = time.perf_counter()
+    res = run(a, b, rhs)
+    res.w.block_until_ready()
+    t3 = time.perf_counter()
+    err = float(l2_error_vs_analytic(prob, res.w))
+    print(
+        f"{M}x{N}: iters={int(res.iters)} diff={float(res.diff):.3e} "
+        f"conv={bool(res.converged)} bd={bool(res.breakdown)} "
+        f"assemble={t1-t0:.3f}s compile+run={t2-t1:.2f}s run={t3-t2:.4f}s "
+        f"l2err={err:.4e}",
+        file=sys.stderr,
+    )
