@@ -14,17 +14,12 @@ checked and reported on stderr; a mismatch marks the run invalid.
 from __future__ import annotations
 
 import json
-import statistics
 import sys
-import time
 
 import jax
-import jax.numpy as jnp
 
+from poisson_ellipse_tpu.harness.run import run_once
 from poisson_ellipse_tpu.models.problem import Problem
-from poisson_ellipse_tpu.ops import assembly
-from poisson_ellipse_tpu.solver.pcg import pcg
-from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
 
 # (M, N, oracle_iters, reference stage4 1-GPU T_solver seconds or None)
 GRIDS = [
@@ -39,32 +34,21 @@ BATCH = 4
 
 
 def bench_grid(M: int, N: int, oracle: int):
-    problem = Problem(M=M, N=N)
-    a, b, rhs = assembly.assemble(problem, jnp.float32)
-    run = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))
-    result = run(a, b, rhs)  # compile + warm-up
-    float(result.diff)  # forced host transfer: the only reliable sync here
-    # Time BATCH back-to-back dispatches with one final scalar fetch as the
-    # sync point: single-stream in-order execution makes syncing the last
-    # result sufficient, and batching amortises the host↔device tunnel RTT
-    # (~0.1 s under axon), which would otherwise swamp the small grids.
-    times = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        for _ in range(BATCH):
-            result = run(a, b, rhs)
-        float(result.diff)
-        times.append((time.perf_counter() - t0) / BATCH)
-    t = statistics.median(times)
-    iters = int(result.iters)
-    err = float(l2_error_vs_analytic(problem, result.w))
-    ok = bool(result.converged) and iters == oracle
+    # run_once provides the measurement protocol: warm-up outside the timed
+    # region, BATCH back-to-back dispatches per repetition (amortising the
+    # host↔device tunnel RTT that would swamp small grids), fenced sync,
+    # median over REPS.
+    report = run_once(
+        Problem(M=M, N=N), mode="single", dtype="f32", repeat=REPS, batch=BATCH
+    )
+    ok = report.converged and report.iters == oracle
     print(
-        f"  {M}x{N}: T_solver={t:.4f}s iters={iters} (oracle {oracle}) "
-        f"converged={bool(result.converged)} l2_err={err:.3e}",
+        f"  {M}x{N}: T_solver={report.t_solver:.4f}s iters={report.iters} "
+        f"(oracle {oracle}) converged={report.converged} "
+        f"l2_err={report.l2_error:.3e}",
         file=sys.stderr,
     )
-    return t, ok
+    return report.t_solver, ok
 
 
 def main() -> int:

@@ -1,0 +1,12 @@
+"""Harness layer (reference L6): CLI, phase profiling, result reporting.
+
+The reference's per-stage ``main`` functions are the model: argv ``M N``
+(``stage2-mpi/poisson_mpi_decomp.cpp:463-502``,
+``stage4-mpi+cuda/poisson_mpi_cuda2.cu:985-1038``), barrier-fenced
+wall-clock segmentation, rank-0 result summary, and (stage0/1) built-in
+grid/thread sweep loops (``stage0/Withoutopenmp1.cpp:176-196``).
+"""
+
+from poisson_ellipse_tpu.harness.run import RunReport, run_once
+
+__all__ = ["RunReport", "run_once"]

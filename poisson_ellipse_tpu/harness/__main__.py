@@ -1,0 +1,146 @@
+"""CLI: ``python -m poisson_ellipse_tpu.harness M N [options]``.
+
+Argv contract extends the reference executables' (``argv[1]=M argv[2]=N``,
+``stage2-mpi/poisson_mpi_decomp.cpp:470-474``,
+``poisson_mpi_cuda2.cu:995-999``; process grid from mpirun → here
+``--mesh``). Multiple grids sweep like stage0/1's built-in loops
+(``stage0/Withoutopenmp1.cpp:176-196``). ``--eps-sweep`` runs the
+fictitious-domain stiffness study of BASELINE.json config 5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from poisson_ellipse_tpu.harness.run import (
+    DTYPES,
+    resolve_dtype,
+    resolve_mesh,
+    run_once,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+
+
+def _parse_grids(args) -> list[tuple[int, int]]:
+    if args.M is not None:
+        return [(args.M, args.N if args.N is not None else args.M)]
+    if args.grids:
+        out = []
+        for spec in args.grids.split(","):
+            m, _, n = spec.lower().partition("x")
+            out.append((int(m), int(n or m)))
+        return out
+    return [(40, 40)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness",
+        description="Fictitious-domain Poisson PCG on TPU",
+    )
+    ap.add_argument("M", type=int, nargs="?", help="grid cells in x")
+    ap.add_argument("N", type=int, nargs="?", help="grid cells in y")
+    ap.add_argument(
+        "--grids", help="comma list of MxN grids to sweep, e.g. 400x600,800x1200"
+    )
+    ap.add_argument(
+        "--mode",
+        choices=("auto", "single", "sharded"),
+        default="auto",
+    )
+    ap.add_argument(
+        "--mesh",
+        type=int,
+        nargs=2,
+        metavar=("PX", "PY"),
+        help="device mesh shape (default: near-square over all devices)",
+    )
+    ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument("--delta", type=float, default=1e-6)
+    ap.add_argument("--eps", type=float, default=None)
+    ap.add_argument(
+        "--eps-sweep",
+        help="comma list of eps values to sweep (overrides --eps)",
+    )
+    ap.add_argument(
+        "--norm", choices=("weighted", "unweighted"), default="weighted"
+    )
+    ap.add_argument("--max-iter", type=int, default=None)
+    ap.add_argument("--repeat", type=int, default=1, help="timing repetitions")
+    ap.add_argument(
+        "--batch",
+        type=int,
+        default=1,
+        help="dispatches per repetition (amortises host<->device RTT)",
+    )
+    ap.add_argument(
+        "--profile",
+        action="store_true",
+        help="segmented per-phase iteration profile (stage4 timer taxonomy)",
+    )
+    ap.add_argument("--json", action="store_true", help="one JSON line per run")
+    args = ap.parse_args(argv)
+
+    eps_values = (
+        [float(e) for e in args.eps_sweep.split(",")]
+        if args.eps_sweep
+        else [args.eps]
+    )
+
+    rc = 0
+    for M, N in _parse_grids(args):
+        for eps in eps_values:
+            problem = Problem(
+                M=M,
+                N=N,
+                delta=args.delta,
+                eps=eps,
+                norm=args.norm,
+                max_iter=args.max_iter,
+            )
+            try:
+                report = run_once(
+                    problem,
+                    mode=args.mode,
+                    mesh_shape=tuple(args.mesh) if args.mesh else None,
+                    dtype=args.dtype,
+                    repeat=args.repeat,
+                    batch=args.batch,
+                )
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            if args.json:
+                print(json.dumps(report.json_dict()))
+            else:
+                print(report.summary())
+            if args.profile:
+                from poisson_ellipse_tpu.harness.profile import (
+                    format_phases,
+                    profile_single,
+                    profile_sharded,
+                )
+
+                jdtype = resolve_dtype(args.dtype)
+                if report.mesh_shape == (1, 1):
+                    phases = profile_single(problem, jdtype)
+                else:
+                    phases = profile_sharded(
+                        problem,
+                        mesh=resolve_mesh(
+                            tuple(args.mesh) if args.mesh else None
+                        ),
+                        dtype=jdtype,
+                    )
+                print(format_phases(phases, report.iters))
+            if not args.json:
+                print()
+            if not report.converged:
+                rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""One measured solve: the TPU analog of the reference's ``main`` drivers.
+
+Reproduces the reference's wall-clock segmentation (program = init +
+solver + finalize, ``poisson_mpi_cuda2.cu:992-1034``) with fenced phase
+timers, and its rank-0 result summary (config echo, "converged after k",
+iteration count, total time, phase breakdown,
+``poisson_mpi_cuda2.cu:1000-1003,1026-1034``) — plus the L2-error-vs-
+analytic metric the reference states but never computes (README.md:38-42;
+no stage computes it — SURVEY §4.1).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
+from poisson_ellipse_tpu.parallel.pcg_sharded import build_sharded_solver
+from poisson_ellipse_tpu.solver.pcg import pcg
+from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
+from poisson_ellipse_tpu.utils.timing import PhaseTimer, fence
+
+DTYPES = {
+    "f32": jnp.float32,
+    "f64": jnp.float64,
+    "bf16": jnp.bfloat16,
+}
+
+
+def resolve_dtype(dtype: str):
+    """Map a dtype name to the jnp dtype, enabling x64 when required.
+
+    Without ``jax_enable_x64``, jnp silently downcasts f64 arrays to f32 —
+    a run labelled f64 would actually produce f32 results. The reference
+    is entirely double precision, so honouring a f64 request means
+    flipping the config switch, not mislabelling.
+    """
+    if dtype == "f64" and not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+    return DTYPES[dtype]
+
+
+def resolve_mesh(mesh_shape: tuple[int, int] | None):
+    """A 2D ('x','y') device mesh: explicit PX×PY, or near-square over all
+    devices (the reference's ``choose_process_grid`` policy)."""
+    if mesh_shape is None:
+        return make_mesh()
+    px, py = mesh_shape
+    devices = jax.devices()
+    if px * py > len(devices):
+        raise ValueError(
+            f"mesh {px}x{py} needs {px * py} devices, have {len(devices)}"
+        )
+    import numpy as np
+
+    return jax.sharding.Mesh(
+        np.asarray(devices[: px * py]).reshape(px, py), (AXIS_X, AXIS_Y)
+    )
+
+
+@dataclass
+class RunReport:
+    """Everything the reference's rank-0 summary prints, plus L2 error."""
+
+    problem: Problem
+    mesh_shape: tuple[int, int]
+    dtype: str
+    iters: int
+    converged: bool
+    breakdown: bool
+    diff: float
+    l2_error: float
+    t_init: float
+    t_solver: float
+    times: list[float] = field(default_factory=list)
+
+    def summary(self) -> str:
+        p = self.problem
+        lines = [
+            f"Grid: {p.M} x {p.N}  (h1={p.h1:.6g}, h2={p.h2:.6g}, "
+            f"eps={p.eps_value:.6g}, delta={p.delta:g}, norm={p.norm})",
+            f"Mesh: {self.mesh_shape[0]} x {self.mesh_shape[1]}  "
+            f"dtype={self.dtype}",
+            (
+                f"Converged after {self.iters} iterations (diff={self.diff:.3e})"
+                if self.converged
+                else (
+                    f"BREAKDOWN after {self.iters} iterations"
+                    if self.breakdown
+                    else f"NOT converged after {self.iters} iterations "
+                    f"(diff={self.diff:.3e})"
+                )
+            ),
+            f"T_init   {self.t_init:10.4f} s",
+            f"T_solver {self.t_solver:10.4f} s"
+            + (
+                f"  (best of {len(self.times)}: "
+                + ", ".join(f"{t:.4f}" for t in self.times)
+                + ")"
+                if len(self.times) > 1
+                else ""
+            ),
+            f"L2 error vs analytic: {self.l2_error:.6e}",
+        ]
+        return "\n".join(lines)
+
+    def json_dict(self) -> dict:
+        p = self.problem
+        return {
+            "M": p.M,
+            "N": p.N,
+            "mesh": list(self.mesh_shape),
+            "dtype": self.dtype,
+            "eps": p.eps_value,
+            "delta": p.delta,
+            "iters": self.iters,
+            "converged": self.converged,
+            "diff": self.diff,
+            "l2_error": self.l2_error,
+            "t_init_s": self.t_init,
+            "t_solver_s": self.t_solver,
+        }
+
+
+def run_once(
+    problem: Problem,
+    mode: str = "auto",
+    mesh_shape: tuple[int, int] | None = None,
+    dtype: str = "f32",
+    repeat: int = 1,
+    batch: int = 1,
+) -> RunReport:
+    """Assemble + solve with fenced init/solver timing.
+
+    mode:  "single" — single-device solver (stage0/1/4-1GPU analog);
+           "sharded" — mesh-sharded solver (stage2/3/4 analog);
+           "auto" — sharded iff >1 device or an explicit mesh is requested.
+    repeat/batch: timing protocol — ``repeat`` measurements of ``batch``
+    back-to-back dispatches each (batch>1 amortises host↔device RTT on
+    tunneled backends); T_solver is the median over measurements.
+    """
+    jdtype = resolve_dtype(dtype)
+    if mode == "auto":
+        mode = (
+            "sharded"
+            if mesh_shape is not None or len(jax.devices()) > 1
+            else "single"
+        )
+
+    timer = PhaseTimer()
+    if mode == "single":
+        with timer.phase("init"):
+            a, b, rhs = assembly.assemble(problem, jdtype)
+            solver = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))
+            args = (a, b, rhs)
+            fence(args)
+        shape = (1, 1)
+    elif mode == "sharded":
+        with timer.phase("init"):
+            mesh = resolve_mesh(mesh_shape)
+            solver, args = build_sharded_solver(problem, mesh, jdtype)
+            fence(args)
+        shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
+    else:
+        raise ValueError(f"unknown mode: {mode!r}")
+
+    # compile + warm-up outside the timed region (the reference likewise
+    # excludes MPI_Init / cudaMalloc from T_solver via its barrier fences)
+    result = solver(*args)
+    fence(result)
+
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        for _ in range(batch):
+            result = solver(*args)
+        fence(result)
+        times.append((time.perf_counter() - t0) / batch)
+    timer.add("solver", statistics.median(times))
+
+    with timer.phase("finalize"):
+        l2 = float(l2_error_vs_analytic(problem, result.w))
+
+    return RunReport(
+        problem=problem,
+        mesh_shape=shape,
+        dtype=dtype,
+        iters=int(result.iters),
+        converged=bool(result.converged),
+        breakdown=bool(result.breakdown),
+        diff=float(result.diff),
+        l2_error=l2,
+        t_init=timer.totals["init"],
+        t_solver=timer.totals["solver"],
+        times=times,
+    )

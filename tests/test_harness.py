@@ -1,0 +1,107 @@
+"""Harness layer: run_once reports, CLI contract, phase profiler.
+
+The reference's manual oracle is its printed rank-0 summary (iteration
+count + time, ``stage2-mpi/poisson_mpi_decomp.cpp:493-498``); these tests
+pin the same facts programmatically: oracle iteration counts, convergence,
+L2 error magnitude, and that the CLI accepts the reference's argv shape
+(``argv[1]=M argv[2]=N``, ``poisson_mpi_cuda2.cu:995-999``).
+"""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from poisson_ellipse_tpu.harness import run_once
+from poisson_ellipse_tpu.harness.__main__ import main as cli_main
+from poisson_ellipse_tpu.harness.profile import (
+    format_phases,
+    profile_single,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+
+
+def test_run_once_single_matches_oracle():
+    report = run_once(Problem(M=40, N=40), mode="single", dtype="f64")
+    assert report.iters == 50  # weighted-norm oracle @ 40x40
+    assert report.converged and not report.breakdown
+    assert report.l2_error == pytest.approx(3.68e-3, rel=0.05)
+    assert report.t_solver > 0 and report.t_init > 0
+    assert "Converged after 50 iterations" in report.summary()
+
+
+def test_run_once_sharded_matches_single():
+    single = run_once(Problem(M=40, N=40), mode="single", dtype="f64")
+    sharded = run_once(Problem(M=40, N=40), mode="sharded", dtype="f64")
+    assert sharded.mesh_shape == (2, 4)  # 8 virtual devices, near-square
+    assert sharded.iters == single.iters
+    assert sharded.l2_error == pytest.approx(single.l2_error, rel=1e-6)
+
+
+def test_run_once_explicit_mesh_shape():
+    report = run_once(
+        Problem(M=20, N=20), mode="sharded", mesh_shape=(2, 2), dtype="f64"
+    )
+    assert report.mesh_shape == (2, 2)
+    assert report.converged
+
+
+def test_cli_positional_grid_and_json(capsys):
+    rc = cli_main(["40", "40", "--mode", "single", "--dtype", "f64", "--json"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip()
+    rec = json.loads(line)
+    assert rec["M"] == 40 and rec["N"] == 40
+    assert rec["iters"] == 50 and rec["converged"] is True
+
+
+def test_cli_grid_sweep_and_eps_sweep(capsys):
+    rc = cli_main(
+        [
+            "--grids",
+            "10x10,20x20",
+            "--mode",
+            "single",
+            "--dtype",
+            "f64",
+            "--json",
+        ]
+    )
+    assert rc == 0
+    recs = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert [r["iters"] for r in recs] == [15, 26]  # weighted oracles
+
+    rc = cli_main(
+        [
+            "20",
+            "20",
+            "--mode",
+            "single",
+            "--dtype",
+            "f64",
+            "--eps-sweep",
+            "1e-2,1e-4",
+            "--json",
+        ]
+    )
+    assert rc == 0
+    recs = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert [r["eps"] for r in recs] == [1e-2, 1e-4]
+    # stiffer fictitious domain (smaller eps) must not take fewer iters
+    assert recs[1]["iters"] >= recs[0]["iters"]
+
+
+def test_cli_unconverged_exit_code():
+    rc = cli_main(
+        ["40", "40", "--mode", "single", "--dtype", "f64", "--max-iter", "3"]
+    )
+    assert rc == 1
+
+
+def test_profile_single_phases():
+    phases = profile_single(Problem(M=32, N=32), jnp.float64, reps=5)
+    assert set(phases) == {"stencil", "dot", "precond", "update", "halo"}
+    assert phases["halo"] == 0.0
+    assert all(v >= 0.0 for v in phases.values())
+    text = format_phases(phases, iters=10)
+    assert "t_stencil" in text and "x10 iters" in text
