@@ -112,13 +112,9 @@ def main(argv=None) -> int:
             except ValueError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
-            if args.json:
-                print(json.dumps(report.json_dict()))
-            else:
-                print(report.summary())
+            phases = None
             if args.profile:
                 from poisson_ellipse_tpu.harness.profile import (
-                    format_phases,
                     profile_single,
                     profile_sharded,
                 )
@@ -134,8 +130,18 @@ def main(argv=None) -> int:
                         ),
                         dtype=jdtype,
                     )
-                print(format_phases(phases, report.iters))
-            if not args.json:
+            if args.json:
+                # keep stdout one JSON line per run: phases ride inside it
+                record = report.json_dict()
+                if phases is not None:
+                    record["phase_s"] = phases
+                print(json.dumps(record))
+            else:
+                from poisson_ellipse_tpu.harness.profile import format_phases
+
+                print(report.summary())
+                if phases is not None:
+                    print(format_phases(phases, report.iters))
                 print()
             if not report.converged:
                 rc = 1

@@ -100,7 +100,7 @@ class RunReport:
             f"T_init   {self.t_init:10.4f} s",
             f"T_solver {self.t_solver:10.4f} s"
             + (
-                f"  (best of {len(self.times)}: "
+                f"  (median of {len(self.times)}: "
                 + ", ".join(f"{t:.4f}" for t in self.times)
                 + ")"
                 if len(self.times) > 1

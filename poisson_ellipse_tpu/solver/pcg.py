@@ -49,11 +49,16 @@ class PCGResult(NamedTuple):
     breakdown: jax.Array
 
 
-def pcg(problem: Problem, a, b, rhs):
+def pcg(problem: Problem, a, b, rhs, stencil: str = "xla"):
     """Run PCG for pre-assembled coefficients. All inputs (M+1, N+1).
 
     Jit-safe with ``problem`` static; the while_loop carries
     (k, w, r, p, zr, diff, converged, breakdown) entirely on device.
+
+    stencil: "xla" (padded-slice arithmetic, XLA-fused) or "pallas" (the
+    explicit VMEM-tiled kernel, ``ops.pallas_kernels.apply_a_pallas``).
+    The two agree to 1-2 ulps — not bitwise — so iteration counts may
+    differ by a step on ill-conditioned grids.
     """
     dtype = rhs.dtype
     h1 = jnp.asarray(problem.h1, dtype)
@@ -61,6 +66,15 @@ def pcg(problem: Problem, a, b, rhs):
     delta = jnp.asarray(problem.delta, dtype)
     max_iter = problem.max_iterations
     weighted = problem.norm == "weighted"
+
+    if stencil == "pallas":
+        from poisson_ellipse_tpu.ops.pallas_kernels import apply_a_pallas
+
+        apply_stencil = lambda p: apply_a_pallas(p, a, b, problem.h1, problem.h2)
+    elif stencil == "xla":
+        apply_stencil = lambda p: apply_a(p, a, b, h1, h2)
+    else:
+        raise ValueError(f"unknown stencil: {stencil!r}")
 
     d = diag_d(a, b, h1, h2)
 
@@ -76,7 +90,7 @@ def pcg(problem: Problem, a, b, rhs):
 
     def body(state):
         k, w, r, p, zr, _diff, _c, _bd = state
-        ap = apply_a(p, a, b, h1, h2)
+        ap = apply_stencil(p)
         denom = grid_dot(ap, p, h1, h2)
         breakdown = denom < DENOM_GUARD
         alpha = zr / jnp.where(breakdown, 1.0, denom)
@@ -124,7 +138,7 @@ def pcg(problem: Problem, a, b, rhs):
     return PCGResult(w=w, iters=k, diff=diff, converged=converged, breakdown=breakdown)
 
 
-def solve(problem: Problem, dtype=jnp.float32) -> PCGResult:
+def solve(problem: Problem, dtype=jnp.float32, stencil: str = "xla") -> PCGResult:
     """Assemble and solve on a single chip (the stage0-shaped entry point)."""
     a, b, rhs = assembly.assemble(problem, dtype)
-    return pcg(problem, a, b, rhs)
+    return pcg(problem, a, b, rhs, stencil=stencil)

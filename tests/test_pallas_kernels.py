@@ -1,0 +1,110 @@
+"""Pallas kernels vs the XLA ops (interpret mode on the CPU backend).
+
+The reference's cross-implementation oracle is agreement between its CPU
+and CUDA paths on identical grids (SURVEY §4.2); here the analog is
+Pallas-vs-XLA agreement on the same arrays, plus solver-level parity of
+iteration counts. On real TPU the compiled kernels match the XLA path to
+1-2 ulps (verified on-chip); in interpret mode most are exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly, pallas_kernels as pk
+from poisson_ellipse_tpu.ops.reduction import grid_dot
+from poisson_ellipse_tpu.ops.stencil import apply_a_block, apply_dinv
+from poisson_ellipse_tpu.solver.pcg import pcg
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+@pytest.mark.parametrize("bm,bn", [(16, 18), (24, 130), (15, 33)])
+def test_stencil_matches_xla(rng, bm, bn):
+    w = jnp.asarray(rng.standard_normal((bm + 2, bn + 2)))
+    a = jnp.asarray(rng.random((bm + 2, bn + 2)) + 0.5)
+    b = jnp.asarray(rng.random((bm + 2, bn + 2)) + 0.5)
+    ref = apply_a_block(w, a, b, 0.01, 0.02)
+    out = pk.apply_a_block_pallas(w, a, b, 0.01, 0.02)
+    assert out.shape == (bm, bn)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-12)
+
+
+def test_stencil_on_assembled_problem(rng):
+    problem = Problem(M=24, N=16)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    w = jnp.asarray(rng.standard_normal(problem.node_shape))
+    ref = apply_a_block(w, a, b, problem.h1, problem.h2)
+    out = pk.apply_a_block_pallas(w, a, b, problem.h1, problem.h2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-12)
+
+
+def test_dinv_matches(rng):
+    d = jnp.asarray(rng.standard_normal((32, 40)))
+    d = jnp.where(jnp.abs(d) < 0.3, 0.0, d)  # exercise the zero guard
+    r = jnp.asarray(rng.standard_normal((32, 40)))
+    assert bool(jnp.all(pk.apply_dinv_pallas(r, d) == apply_dinv(r, d)))
+
+
+def test_dot_matches(rng):
+    x = jnp.asarray(rng.standard_normal((32, 40)))
+    y = jnp.asarray(rng.standard_normal((32, 40)))
+    got = pk.dot_pallas(x, y, 0.01, 0.02)
+    want = grid_dot(x, y, 0.01, 0.02)
+    assert float(abs(got - want)) < 1e-12 * abs(float(want))
+
+
+def test_update_w_r_fused(rng):
+    w = jnp.asarray(rng.standard_normal((16, 24)))
+    r = jnp.asarray(rng.standard_normal((16, 24)))
+    p = jnp.asarray(rng.standard_normal((16, 24)))
+    ap = jnp.asarray(rng.standard_normal((16, 24)))
+    alpha = jnp.asarray(0.37)
+    w_new, r_new, dw2 = pk.update_w_r_pallas(alpha, w, r, p, ap)
+    # FMA contraction differs between the paths: ulp-level agreement only
+    np.testing.assert_allclose(
+        np.asarray(w_new), np.asarray(w + alpha * p), rtol=1e-13
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_new), np.asarray(r - alpha * ap), rtol=1e-13
+    )
+    assert float(abs(dw2 - jnp.sum((alpha * p) ** 2))) < 1e-12
+
+
+def test_update_p(rng):
+    z = jnp.asarray(rng.standard_normal((16, 24)))
+    p = jnp.asarray(rng.standard_normal((16, 24)))
+    beta = jnp.asarray(0.9)
+    # rtol alone is not enough: where z + βp cancels to ~0 the FMA-vs-mul
+    # ulp difference is unbounded relatively
+    np.testing.assert_allclose(
+        np.asarray(pk.update_p_pallas(beta, z, p)),
+        np.asarray(z + beta * p),
+        rtol=1e-13,
+        atol=1e-14,
+    )
+
+
+def test_pcg_with_pallas_stencil_matches_oracle():
+    problem = Problem(M=10, N=10, norm="unweighted")
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    res = pcg(problem, a, b, rhs, stencil="pallas")
+    # unweighted-norm oracle @ 10x10 (compiled reference stage0 binary)
+    assert int(res.iters) == 17
+    assert bool(res.converged)
+    res_xla = pcg(problem, a, b, rhs, stencil="xla")
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(res_xla.w), rtol=1e-10, atol=1e-14
+    )
+
+
+def test_pcg_rejects_unknown_stencil():
+    problem = Problem(M=8, N=8)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    with pytest.raises(ValueError, match="unknown stencil"):
+        pcg(problem, a, b, rhs, stencil="cuda")
