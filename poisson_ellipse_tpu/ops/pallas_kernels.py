@@ -49,16 +49,33 @@ TILE_ROWS = 128
 
 
 def _row_tile(g1: int) -> int:
-    """Largest power-of-two row tile dividing g1 (whole array if none).
+    """Largest 8-multiple row tile ≤ 512 dividing g1 (whole array if none).
 
     The elementwise/reduction kernels use plain BlockSpec pipelining, so
-    the tile must divide the row count exactly; callers with awkward row
-    counts get a single whole-array block (small grids only).
+    the tile must divide the row count exactly; callers pad rows to an
+    8-multiple first (``_pad_rows``), which guarantees a divisor exists
+    for any realistic grid.
     """
-    for tm in (512, 256, 128, 64, 32, 16, 8):
+    for tm in range(min(512, g1), 7, -8):
         if g1 % tm == 0:
             return tm
     return g1
+
+
+def _pad_rows(*arrays):
+    """Zero-pad each (g1, g2) array to an 8-multiple row count.
+
+    Node grids are (M+1, N+1) — an odd row count for every even-M
+    benchmark size — and a whole-array VMEM block would overflow on big
+    grids, so the elementwise kernels tile over an 8-aligned padding
+    instead (padding rows are zeros: harmless to the reductions, sliced
+    off the outputs).
+    """
+    g1 = arrays[0].shape[0]
+    k = round_up(g1, 8)
+    if k == g1:
+        return arrays
+    return tuple(jnp.pad(x, ((0, k - g1), (0, 0))) for x in arrays)
 
 
 def _interpret_default() -> bool:
@@ -170,10 +187,12 @@ def apply_dinv_pallas(r, d, interpret=None):
     if interpret is None:
         interpret = _interpret_default()
     g1, g2 = r.shape
-    tm = _row_tile(g1)
-    return pl.pallas_call(
+    r_p, d_p = _pad_rows(r, d)
+    k = r_p.shape[0]
+    tm = _row_tile(k)
+    out = pl.pallas_call(
         _dinv_kernel,
-        grid=(g1 // tm,),
+        grid=(k // tm,),
         in_specs=[
             pl.BlockSpec((tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -181,9 +200,10 @@ def apply_dinv_pallas(r, d, interpret=None):
         out_specs=pl.BlockSpec(
             (tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((g1, g2), r.dtype),
+        out_shape=jax.ShapeDtypeStruct((k, g2), r.dtype),
         interpret=interpret,
-    )(r, d)
+    )(r_p, d_p)
+    return out[:g1]
 
 
 def _dot_kernel(x_ref, y_ref, out_ref, acc):
@@ -206,11 +226,13 @@ def dot_pallas(x, y, h1, h2, interpret=None):
     """
     if interpret is None:
         interpret = _interpret_default()
-    g1, g2 = x.shape
-    tm = _row_tile(g1)
+    g2 = x.shape[1]
+    x_p, y_p = _pad_rows(x, y)  # zero rows contribute nothing to the sum
+    k = x_p.shape[0]
+    tm = _row_tile(k)
     s = pl.pallas_call(
         _dot_kernel,
-        grid=(g1 // tm,),
+        grid=(k // tm,),
         in_specs=[
             pl.BlockSpec((tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -219,7 +241,7 @@ def dot_pallas(x, y, h1, h2, interpret=None):
         out_shape=jax.ShapeDtypeStruct((1,), x.dtype),
         scratch_shapes=[pltpu.SMEM((1,), x.dtype)],
         interpret=interpret,
-    )(x, y)
+    )(x_p, y_p)
     return s[0] * jnp.asarray(h1, x.dtype) * jnp.asarray(h2, x.dtype)
 
 
@@ -230,9 +252,14 @@ def _update_wr_kernel(alpha_ref, w_ref, r_ref, p_ref, ap_ref,
         acc[0] = jnp.zeros((), w_ref.dtype)
 
     alpha = alpha_ref[0]
-    dw = alpha * p_ref[:]
-    w_out[:] = w_ref[:] + dw
+    w_old = w_ref[:]
+    w_new = w_old + alpha * p_ref[:]
+    w_out[:] = w_new
     r_out[:] = r_ref[:] - alpha * ap_ref[:]
+    # realised increment (w_new - w_old), not alpha*p: the two differ in
+    # FP and the convergence oracle counts depend on it (cu:626-660 also
+    # differences the stored iterates)
+    dw = w_new - w_old
     acc[0] += jnp.sum(dw * dw)
 
     @pl.when(pl.program_id(0) == pl.num_programs(0) - 1)
@@ -249,13 +276,15 @@ def update_w_r_pallas(alpha, w, r, p, ap, interpret=None):
     if interpret is None:
         interpret = _interpret_default()
     g1, g2 = w.shape
-    tm = _row_tile(g1)
+    w_p, r_p, p_p, ap_p = _pad_rows(w, r, p, ap)
+    k = w_p.shape[0]
+    tm = _row_tile(k)
     blk = lambda: pl.BlockSpec(
         (tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
     w_new, r_new, dw2 = pl.pallas_call(
         _update_wr_kernel,
-        grid=(g1 // tm,),
+        grid=(k // tm,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             blk(),
@@ -265,14 +294,14 @@ def update_w_r_pallas(alpha, w, r, p, ap, interpret=None):
         ],
         out_specs=(blk(), blk(), pl.BlockSpec(memory_space=pltpu.SMEM)),
         out_shape=(
-            jax.ShapeDtypeStruct((g1, g2), w.dtype),
-            jax.ShapeDtypeStruct((g1, g2), w.dtype),
+            jax.ShapeDtypeStruct((k, g2), w.dtype),
+            jax.ShapeDtypeStruct((k, g2), w.dtype),
             jax.ShapeDtypeStruct((1,), w.dtype),
         ),
         scratch_shapes=[pltpu.SMEM((1,), w.dtype)],
         interpret=interpret,
-    )(jnp.reshape(alpha, (1,)), w, r, p, ap)
-    return w_new, r_new, dw2[0]
+    )(jnp.reshape(alpha, (1,)), w_p, r_p, p_p, ap_p)
+    return w_new[:g1], r_new[:g1], dw2[0]
 
 
 def _update_p_kernel(beta_ref, z_ref, p_ref, out_ref):
@@ -284,15 +313,17 @@ def update_p_pallas(beta, z, p, interpret=None):
     if interpret is None:
         interpret = _interpret_default()
     g1, g2 = p.shape
-    tm = _row_tile(g1)
+    z_p, p_p = _pad_rows(z, p)
+    k = z_p.shape[0]
+    tm = _row_tile(k)
     blk = lambda: pl.BlockSpec(
         (tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
     return pl.pallas_call(
         _update_p_kernel,
-        grid=(g1 // tm,),
+        grid=(k // tm,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), blk(), blk()],
         out_specs=blk(),
-        out_shape=jax.ShapeDtypeStruct((g1, g2), p.dtype),
+        out_shape=jax.ShapeDtypeStruct((k, g2), p.dtype),
         interpret=interpret,
-    )(jnp.reshape(beta, (1,)), z, p)
+    )(jnp.reshape(beta, (1,)), z_p, p_p)[:g1]

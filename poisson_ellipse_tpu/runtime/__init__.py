@@ -1,0 +1,22 @@
+"""Native C++/OpenMP host runtime (reference stage0/stage1 parity).
+
+``native.solve_native`` runs the full fictitious-domain PCG in C++ —
+sequential with ``threads=1`` (stage0) or OpenMP-parallel (stage1) — and
+serves as an independent host oracle for the JAX/TPU path.
+"""
+
+from poisson_ellipse_tpu.runtime.native import (
+    NativeResult,
+    assemble_native,
+    native_available,
+    num_threads,
+    solve_native,
+)
+
+__all__ = [
+    "NativeResult",
+    "assemble_native",
+    "native_available",
+    "num_threads",
+    "solve_native",
+]

@@ -1,0 +1,276 @@
+// Native host runtime: fictitious-domain Poisson PCG on CPU.
+//
+// Covers the reference's stage0 (sequential C++) and stage1 (OpenMP)
+// capabilities natively — same numerics as the JAX/TPU path of this
+// framework, so it doubles as an independent host-side oracle:
+//   geometry        ~ stage0/Withoutopenmp1.cpp:14-39
+//   assembly        ~ stage0/Withoutopenmp1.cpp:42-61
+//   stencil/precond ~ stage0/Withoutopenmp1.cpp:75-103
+//   PCG driver      ~ stage0/Withoutopenmp1.cpp:106-172
+//   OpenMP layer    ~ stage1-openmp/Withopenmp1.cpp (collapse(2) loops,
+//                     reduction dots)
+// (Citations document behavioural parity; the implementation is this
+// framework's own: flat row-major arrays, one translation unit, a C ABI
+// for ctypes, no per-iteration allocation — the reference's stage0
+// allocates an M×N matrix every iteration, a known perf bug not copied.)
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC (see build_native.py).
+
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+struct Grid {
+  int M, N;            // cells in x / y; nodes 0..M x 0..N
+  double a1, b1, a2, b2;
+  double h1, h2;
+  double eps;
+  std::int64_t cols;   // N + 1 (row-major pitch)
+  std::int64_t idx(int i, int j) const { return i * cols + j; }
+  double x(int i) const { return a1 + i * h1; }
+  double y(int j) const { return a2 + j * h2; }
+};
+
+// --- L0 geometry: ellipse D = {x^2 + 4 y^2 < 1} ---------------------------
+
+inline bool in_domain(double x, double y) {
+  return x * x + 4.0 * y * y < 1.0;
+}
+
+// Length of {x fixed} x [y0, y1] inside D (closed form).
+inline double vertical_len_in_d(double x, double y0, double y1) {
+  double disc = 1.0 - x * x;
+  if (disc <= 0.0) return 0.0;
+  double half = 0.5 * std::sqrt(disc);  // |y| < half inside
+  double lo = y0 > -half ? y0 : -half;
+  double hi = y1 < half ? y1 : half;
+  return hi > lo ? hi - lo : 0.0;
+}
+
+// Length of [x0, x1] x {y fixed} inside D.
+inline double horizontal_len_in_d(double y, double x0, double x1) {
+  double disc = 1.0 - 4.0 * y * y;
+  if (disc <= 0.0) return 0.0;
+  double half = std::sqrt(disc);  // |x| < half inside
+  double lo = x0 > -half ? x0 : -half;
+  double hi = x1 < half ? x1 : half;
+  return hi > lo ? hi - lo : 0.0;
+}
+
+// --- L1 assembly: per-face diffusion coefficients + indicator RHS ---------
+
+inline double blend(double len, double h, double eps) {
+  if (std::fabs(len - h) < 1e-9) return 1.0;
+  if (len < 1e-9) return 1.0 / eps;
+  double frac = len / h;
+  return frac + (1.0 - frac) / eps;
+}
+
+void assemble(const Grid& g, double f_val, std::vector<double>& a,
+              std::vector<double>& b, std::vector<double>& rhs) {
+#ifdef _OPENMP
+#pragma omp parallel for collapse(2) schedule(static)
+#endif
+  for (int i = 1; i <= g.M; ++i)
+    for (int j = 1; j <= g.N; ++j) {
+      double xf = g.x(i) - 0.5 * g.h1;
+      double yf = g.y(j) - 0.5 * g.h2;
+      a[g.idx(i, j)] =
+          blend(vertical_len_in_d(xf, yf, yf + g.h2), g.h2, g.eps);
+      b[g.idx(i, j)] =
+          blend(horizontal_len_in_d(yf, xf, xf + g.h1), g.h1, g.eps);
+    }
+#ifdef _OPENMP
+#pragma omp parallel for collapse(2) schedule(static)
+#endif
+  for (int i = 1; i < g.M; ++i)
+    for (int j = 1; j < g.N; ++j)
+      rhs[g.idx(i, j)] = in_domain(g.x(i), g.y(j)) ? f_val : 0.0;
+}
+
+// --- L3 operators ---------------------------------------------------------
+
+// out = A.v on the interior (boundary ring untouched = 0).
+void apply_a(const Grid& g, const std::vector<double>& a,
+             const std::vector<double>& b, const std::vector<double>& v,
+             std::vector<double>& out) {
+#ifdef _OPENMP
+#pragma omp parallel for collapse(2) schedule(static)
+#endif
+  for (int i = 1; i < g.M; ++i)
+    for (int j = 1; j < g.N; ++j) {
+      std::int64_t c = g.idx(i, j);
+      double vc = v[c];
+      double dx = a[g.idx(i + 1, j)] * (v[g.idx(i + 1, j)] - vc) / g.h1 -
+                  a[c] * (vc - v[g.idx(i - 1, j)]) / g.h1;
+      double dy = b[g.idx(i, j + 1)] * (v[g.idx(i, j + 1)] - vc) / g.h2 -
+                  b[c] * (vc - v[g.idx(i, j - 1)]) / g.h2;
+      out[c] = -dx / g.h1 - dy / g.h2;
+    }
+}
+
+// z = r / diag(A), guarded; diag = (a_{i+1,j}+a_ij)/h1^2 + (b_{i,j+1}+b_ij)/h2^2.
+void apply_dinv(const Grid& g, const std::vector<double>& a,
+                const std::vector<double>& b, const std::vector<double>& r,
+                std::vector<double>& z) {
+#ifdef _OPENMP
+#pragma omp parallel for collapse(2) schedule(static)
+#endif
+  for (int i = 1; i < g.M; ++i)
+    for (int j = 1; j < g.N; ++j) {
+      std::int64_t c = g.idx(i, j);
+      double d = (a[g.idx(i + 1, j)] + a[c]) / (g.h1 * g.h1) +
+                 (b[g.idx(i, j + 1)] + b[c]) / (g.h2 * g.h2);
+      z[c] = d != 0.0 ? r[c] / d : 0.0;
+    }
+}
+
+// Grid-weighted inner product h1 h2 sum(u v) over the interior.
+double dot(const Grid& g, const std::vector<double>& u,
+           const std::vector<double>& v) {
+  double s = 0.0;
+#ifdef _OPENMP
+#pragma omp parallel for collapse(2) schedule(static) reduction(+ : s)
+#endif
+  for (int i = 1; i < g.M; ++i)
+    for (int j = 1; j < g.N; ++j) s += u[g.idx(i, j)] * v[g.idx(i, j)];
+  return s * g.h1 * g.h2;
+}
+
+}  // namespace
+
+// --- L5/L6: C ABI solver entry -------------------------------------------
+
+extern "C" {
+
+// Solve -Lap(u) = f on D (fictitious domain) with diagonal PCG.
+//   norm_weighted: 1 -> ||dw|| = sqrt(sum dw^2 * h1 h2) (stages 1-4),
+//                  0 -> sqrt(sum dw^2)                  (stage0 v1).
+//   eps <= 0 or max_iter <= 0 select the defaults max(h1,h2)^2 and
+//   (M-1)(N-1). n_threads <= 0 keeps the OpenMP default.
+// Returns 0 converged, 1 not converged, 2 PCG breakdown, -1 bad args.
+int pe_solve(int M, int N, double a1, double b1, double a2, double b2,
+             double f_val, double delta, double eps, int max_iter,
+             int norm_weighted, int n_threads, double* w_out,
+             int* iters_out, double* diff_out) {
+  if (M < 2 || N < 2 || !w_out || !iters_out || !diff_out) return -1;
+#ifdef _OPENMP
+  // omp_set_num_threads is process-global and sticky: save and restore so
+  // threads=0 ("OpenMP default") still means the default after a call with
+  // an explicit count
+  int prev_threads = omp_get_max_threads();
+  if (n_threads > 0) omp_set_num_threads(n_threads);
+#else
+  (void)n_threads;
+#endif
+  Grid g;
+  g.M = M; g.N = N;
+  g.a1 = a1; g.b1 = b1; g.a2 = a2; g.b2 = b2;
+  g.h1 = (b1 - a1) / M;
+  g.h2 = (b2 - a2) / N;
+  double h = g.h1 > g.h2 ? g.h1 : g.h2;
+  g.eps = eps > 0.0 ? eps : h * h;
+  g.cols = N + 1;
+  if (max_iter <= 0) max_iter = (M - 1) * (N - 1);
+
+  std::int64_t n = static_cast<std::int64_t>(M + 1) * (N + 1);
+  std::vector<double> a(n, 0.0), b(n, 0.0), rhs(n, 0.0);
+  assemble(g, f_val, a, b, rhs);
+
+  std::vector<double> w(n, 0.0), r(rhs), z(n, 0.0), p(n, 0.0), ap(n, 0.0);
+  apply_dinv(g, a, b, r, z);
+  p = z;
+  double zr = dot(g, z, r);
+
+  int k = 0;
+  int status = 1;
+  double diff = 0.0;
+  while (k < max_iter) {
+    ++k;
+    apply_a(g, a, b, p, ap);
+    double denom = dot(g, ap, p);
+    if (denom < 1e-15) { status = 2; break; }
+    double alpha = zr / denom;
+
+    double dw2 = 0.0;
+#ifdef _OPENMP
+#pragma omp parallel for collapse(2) schedule(static) reduction(+ : dw2)
+#endif
+    for (int i = 1; i < M; ++i)
+      for (int j = 1; j < N; ++j) {
+        std::int64_t c = g.idx(i, j);
+        double w_old = w[c];
+        w[c] = w_old + alpha * p[c];
+        r[c] -= alpha * ap[c];
+        // realised increment (w_new - w_old), not alpha*p: the two differ
+        // in FP and the convergence oracle counts depend on it
+        double step = w[c] - w_old;
+        dw2 += step * step;
+      }
+
+    apply_dinv(g, a, b, r, z);
+    double zr_new = dot(g, z, r);
+
+    diff = norm_weighted ? std::sqrt(dw2 * g.h1 * g.h2) : std::sqrt(dw2);
+    if (diff < delta) { status = 0; break; }
+
+    double beta = zr_new / zr;
+    zr = zr_new;
+#ifdef _OPENMP
+#pragma omp parallel for collapse(2) schedule(static)
+#endif
+    for (int i = 1; i < M; ++i)
+      for (int j = 1; j < N; ++j) {
+        std::int64_t c = g.idx(i, j);
+        p[c] = z[c] + beta * p[c];
+      }
+  }
+
+  for (std::int64_t t = 0; t < n; ++t) w_out[t] = w[t];
+  *iters_out = k;
+  *diff_out = diff;
+#ifdef _OPENMP
+  omp_set_num_threads(prev_threads);
+#endif
+  return status;
+}
+
+// Assemble-only entry for cross-checking the JAX assembly (golden tests).
+int pe_assemble(int M, int N, double a1, double b1, double a2, double b2,
+                double f_val, double eps, double* a_out, double* b_out,
+                double* rhs_out) {
+  if (M < 2 || N < 2 || !a_out || !b_out || !rhs_out) return -1;
+  Grid g;
+  g.M = M; g.N = N;
+  g.a1 = a1; g.b1 = b1; g.a2 = a2; g.b2 = b2;
+  g.h1 = (b1 - a1) / M;
+  g.h2 = (b2 - a2) / N;
+  double h = g.h1 > g.h2 ? g.h1 : g.h2;
+  g.eps = eps > 0.0 ? eps : h * h;
+  g.cols = N + 1;
+  std::int64_t n = static_cast<std::int64_t>(M + 1) * (N + 1);
+  std::vector<double> a(n, 0.0), b(n, 0.0), rhs(n, 0.0);
+  assemble(g, f_val, a, b, rhs);
+  for (std::int64_t t = 0; t < n; ++t) {
+    a_out[t] = a[t];
+    b_out[t] = b[t];
+    rhs_out[t] = rhs[t];
+  }
+  return 0;
+}
+
+int pe_num_threads() {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

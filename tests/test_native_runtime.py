@@ -1,0 +1,82 @@
+"""Native C++ runtime vs oracles and vs the JAX path.
+
+Mirrors the reference's cross-implementation oracle (identical PCG
+iteration counts across its sequential/OpenMP/MPI/CUDA stages, SURVEY
+§4.2): the C++ runtime and the JAX solver must agree on iteration counts
+and, in f64, on the solution itself.
+"""
+
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.runtime import (
+    assemble_native,
+    native_available,
+    solve_native,
+)
+from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="C++ runtime could not be built"
+)
+
+ORACLES_UNWEIGHTED = {(10, 10): 17, (20, 20): 31, (40, 40): 61}
+ORACLES_WEIGHTED = {(10, 10): 15, (20, 20): 26, (40, 40): 50}
+
+
+@pytest.mark.parametrize("grid,iters", sorted(ORACLES_UNWEIGHTED.items()))
+def test_iteration_oracles_unweighted(grid, iters):
+    r = solve_native(Problem(M=grid[0], N=grid[1], norm="unweighted"))
+    assert r.converged and r.iters == iters
+
+
+@pytest.mark.parametrize("grid,iters", sorted(ORACLES_WEIGHTED.items()))
+def test_iteration_oracles_weighted(grid, iters):
+    r = solve_native(Problem(M=grid[0], N=grid[1], norm="weighted"))
+    assert r.converged and r.iters == iters
+
+
+def test_assembly_matches_jax_host_assembly():
+    problem = Problem(M=24, N=18)
+    a_c, b_c, rhs_c = assemble_native(problem)
+    a_j, b_j, rhs_j = assembly.assemble_numpy(problem)
+    np.testing.assert_allclose(a_c, a_j, rtol=1e-14)
+    np.testing.assert_allclose(b_c, b_j, rtol=1e-14)
+    np.testing.assert_array_equal(rhs_c, rhs_j)
+
+
+def test_solution_matches_jax_f64():
+    import jax.numpy as jnp
+
+    from poisson_ellipse_tpu.solver.pcg import solve
+
+    problem = Problem(M=40, N=40)
+    r_c = solve_native(problem)
+    r_j = solve(problem, jnp.float64)
+    assert r_c.iters == int(r_j.iters)
+    np.testing.assert_allclose(
+        r_c.w, np.asarray(r_j.w), rtol=1e-8, atol=1e-12
+    )
+    err = float(l2_error_vs_analytic(problem, jnp.asarray(r_c.w)))
+    assert err == pytest.approx(3.68e-3, rel=0.05)
+
+
+def test_thread_count_does_not_change_iterations():
+    problem = Problem(M=40, N=40)
+    base = solve_native(problem, threads=1)
+    for threads in (2, 4):
+        r = solve_native(problem, threads=threads)
+        assert r.iters == base.iters
+        np.testing.assert_allclose(r.w, base.w, rtol=1e-12, atol=1e-15)
+
+
+def test_max_iter_cap_reports_not_converged():
+    r = solve_native(Problem(M=40, N=40, max_iter=3))
+    assert not r.converged and not r.breakdown and r.iters == 3
+
+
+def test_bad_args_raise():
+    with pytest.raises(ValueError):
+        Problem(M=1, N=1)  # guarded upstream
