@@ -11,6 +11,7 @@ fictitious-domain stiffness study of BASELINE.json config 5.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 
@@ -80,6 +81,11 @@ def main(argv=None) -> int:
         action="store_true",
         help="segmented per-phase iteration profile (stage4 timer taxonomy)",
     )
+    ap.add_argument(
+        "--trace-dir",
+        help="capture a jax.profiler trace of the solve into this directory "
+        "(open with TensorBoard / xprof)",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON line per run")
     args = ap.parse_args(argv)
 
@@ -101,14 +107,25 @@ def main(argv=None) -> int:
                 max_iter=args.max_iter,
             )
             try:
-                report = run_once(
-                    problem,
-                    mode=args.mode,
-                    mesh_shape=tuple(args.mesh) if args.mesh else None,
-                    dtype=args.dtype,
-                    repeat=args.repeat,
-                    batch=args.batch,
+                import jax
+
+                # jax.profiler trace around the measured solve — the TPU
+                # analog of the reference's per-phase timers beyond what
+                # the fenced PhaseTimer's coarse split covers (SURVEY §5)
+                trace_cm = (
+                    jax.profiler.trace(args.trace_dir)
+                    if args.trace_dir
+                    else contextlib.nullcontext()
                 )
+                with trace_cm:
+                    report = run_once(
+                        problem,
+                        mode=args.mode,
+                        mesh_shape=tuple(args.mesh) if args.mesh else None,
+                        dtype=args.dtype,
+                        repeat=args.repeat,
+                        batch=args.batch,
+                    )
             except ValueError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2

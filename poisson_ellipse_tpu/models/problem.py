@@ -50,6 +50,11 @@ class Problem:
             raise ValueError("need M >= 2 and N >= 2 for a nonempty interior")
         if self.norm not in ("weighted", "unweighted"):
             raise ValueError(f"unknown norm convention: {self.norm!r}")
+        # a non-positive eps would silently select the native runtime's
+        # default while the JAX path used the literal value — reject it
+        # here so every backend sees the same problem
+        if self.eps is not None and self.eps <= 0:
+            raise ValueError("eps must be positive (or None for the default)")
 
     @property
     def h1(self) -> float:

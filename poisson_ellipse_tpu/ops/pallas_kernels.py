@@ -48,18 +48,28 @@ from jax.experimental.pallas import tpu as pltpu
 TILE_ROWS = 128
 
 
-def _row_tile(g1: int) -> int:
-    """Largest 8-multiple row tile ≤ 512 dividing g1 (whole array if none).
+# VMEM working-set budget for one kernel invocation's live blocks. The
+# hardware has ~16 MB; leave headroom for Mosaic's own pipeline buffers.
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _row_tile(g1: int, g2: int, itemsize: int, n_buffers: int) -> int:
+    """Largest 8-multiple row tile dividing g1 whose n_buffers blocks
+    (double-buffered by the pipeline) fit the VMEM budget.
 
     The elementwise/reduction kernels use plain BlockSpec pipelining, so
     the tile must divide the row count exactly; callers pad rows to an
-    8-multiple first (``_pad_rows``), which guarantees a divisor exists
-    for any realistic grid.
+    8-multiple first (``_pad_rows``), which guarantees a divisor exists.
+    Bounding by bytes (not a fixed row cap) keeps wide benchmark grids
+    like 3201-column 2400x3200 compilable.
     """
-    for tm in range(min(512, g1), 7, -8):
+    row_bytes = g2 * itemsize * n_buffers * 2  # ×2: pipeline double buffer
+    cap = max(_VMEM_BUDGET_BYTES // max(row_bytes, 1), 8)
+    best = 8
+    for tm in range(8, min(cap, g1) + 1, 8):
         if g1 % tm == 0:
-            return tm
-    return g1
+            best = tm
+    return best if g1 % 8 == 0 else g1
 
 
 def _pad_rows(*arrays):
@@ -189,7 +199,7 @@ def apply_dinv_pallas(r, d, interpret=None):
     g1, g2 = r.shape
     r_p, d_p = _pad_rows(r, d)
     k = r_p.shape[0]
-    tm = _row_tile(k)
+    tm = _row_tile(k, g2, r.dtype.itemsize, 3)
     out = pl.pallas_call(
         _dinv_kernel,
         grid=(k // tm,),
@@ -229,7 +239,7 @@ def dot_pallas(x, y, h1, h2, interpret=None):
     g2 = x.shape[1]
     x_p, y_p = _pad_rows(x, y)  # zero rows contribute nothing to the sum
     k = x_p.shape[0]
-    tm = _row_tile(k)
+    tm = _row_tile(k, g2, x.dtype.itemsize, 2)
     s = pl.pallas_call(
         _dot_kernel,
         grid=(k // tm,),
@@ -278,7 +288,7 @@ def update_w_r_pallas(alpha, w, r, p, ap, interpret=None):
     g1, g2 = w.shape
     w_p, r_p, p_p, ap_p = _pad_rows(w, r, p, ap)
     k = w_p.shape[0]
-    tm = _row_tile(k)
+    tm = _row_tile(k, g2, w.dtype.itemsize, 6)
     blk = lambda: pl.BlockSpec(
         (tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
@@ -315,7 +325,7 @@ def update_p_pallas(beta, z, p, interpret=None):
     g1, g2 = p.shape
     z_p, p_p = _pad_rows(z, p)
     k = z_p.shape[0]
-    tm = _row_tile(k)
+    tm = _row_tile(k, g2, p.dtype.itemsize, 3)
     blk = lambda: pl.BlockSpec(
         (tm, g2), lambda i: (i, 0), memory_space=pltpu.VMEM
     )

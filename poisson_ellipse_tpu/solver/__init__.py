@@ -1,6 +1,27 @@
 """Solver drivers (reference layer L5): the preconditioned conjugate-gradient
-iteration as a fully on-device ``lax.while_loop``."""
+iteration as a fully on-device ``lax.while_loop``, resumable state, and
+orbax-backed checkpointing."""
 
-from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg, solve
+from poisson_ellipse_tpu.solver.checkpoint import (
+    CheckpointingSolver,
+    solve_with_checkpoints,
+)
+from poisson_ellipse_tpu.solver.pcg import (
+    PCGResult,
+    advance,
+    init_state,
+    pcg,
+    result_of,
+    solve,
+)
 
-__all__ = ["PCGResult", "pcg", "solve"]
+__all__ = [
+    "CheckpointingSolver",
+    "PCGResult",
+    "advance",
+    "init_state",
+    "pcg",
+    "result_of",
+    "solve",
+    "solve_with_checkpoints",
+]

@@ -1,0 +1,200 @@
+"""Checkpoint / resume for long PCG solves (orbax-backed).
+
+The reference has no checkpointing at all — solver state is never
+serialised, runs are start-to-finish (SURVEY §5 "Checkpoint / resume:
+None"). This subsystem adds it the TPU-native way: the PCG carry
+(``solver.pcg.init_state`` layout) is saved through orbax every
+``chunk`` iterations and a restart resumes exactly: chunking only moves
+the ``lax.while_loop`` boundary, not the arithmetic, so a checkpointed
+run converges in the same iteration count as a straight one (asserted in
+tests; the iterates agree bitwise under one compilation and to the ulp
+across jit boundaries).
+
+A checkpoint records a fingerprint of the Problem + dtype; resuming onto
+a different discretisation is refused rather than silently producing a
+mixed-state solve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import (
+    PCGResult,
+    advance,
+    init_state,
+    result_of,
+)
+
+STATE_KEYS = ("k", "w", "r", "p", "zr", "diff", "converged", "breakdown")
+
+
+def _fingerprint(problem: Problem, dtype) -> dict:
+    fp = dataclasses.asdict(problem)
+    fp["dtype"] = str(jnp.dtype(dtype))
+    return fp
+
+
+def _state_to_tree(state) -> dict:
+    return dict(zip(STATE_KEYS, state))
+
+
+def _tree_to_state(tree):
+    return tuple(jnp.asarray(tree[k]) for k in STATE_KEYS)
+
+
+class CheckpointingSolver:
+    """Single-chip PCG that persists its carry every ``chunk`` iterations.
+
+    >>> solver = CheckpointingSolver(problem, "/path/ckpts", chunk=500)
+    >>> result = solver.run()          # resumes automatically if killed
+    """
+
+    def __init__(
+        self,
+        problem: Problem,
+        directory: str,
+        chunk: int = 500,
+        dtype=jnp.float32,
+        stencil: str = "xla",
+        keep: int = 2,
+    ):
+        import orbax.checkpoint as ocp
+
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1")
+        self.problem = problem
+        self.chunk = chunk
+        self.dtype = dtype
+        self.stencil = stencil
+        self.directory = os.path.abspath(directory)
+        self._fp = _fingerprint(problem, dtype)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True
+            ),
+        )
+        self._a, self._b, self._rhs = assembly.assemble(problem, dtype)
+        # one compiled advance reused for every chunk: the bound rides in
+        # as a traced scalar
+        self._advance = jax.jit(
+            lambda state, limit: advance(
+                problem,
+                self._a,
+                self._b,
+                self._rhs,
+                state,
+                limit=limit,
+                stencil=stencil,
+            )
+        )
+
+    # -- persistence --------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def _save(self, state) -> None:
+        import orbax.checkpoint as ocp
+
+        step = int(state[0])
+        # async save: orbax snapshots the arrays and serialises in the
+        # background while the next chunk runs; completion is awaited only
+        # before a restore or at close()
+        self._manager.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(_state_to_tree(state)),
+                meta=ocp.args.JsonSave(self._fp),
+            ),
+        )
+
+    def _restore(self, step: int):
+        import orbax.checkpoint as ocp
+
+        self._manager.wait_until_finished()  # drain any in-flight save
+        # metadata first: the fingerprint guard must fire before orbax
+        # would trip on mismatched array shapes with an opaque error
+        meta = self._manager.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )["meta"]
+        if meta != self._fp:
+            raise ValueError(
+                "checkpoint was written by a different problem/dtype: "
+                f"saved {meta}, current {self._fp}"
+            )
+        restored = self._manager.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(
+                    _state_to_tree(init_state(
+                        self.problem, self._a, self._b, self._rhs
+                    ))
+                ),
+            ),
+        )
+        return _tree_to_state(restored["state"])
+
+    # -- driving ------------------------------------------------------------
+
+    def run(self, resume: bool = True) -> PCGResult:
+        """Solve to convergence, saving every ``chunk`` iterations.
+
+        resume=True picks up from the newest valid checkpoint in
+        ``directory`` (a restart after a kill continues mid-solve);
+        resume=False starts from iteration 0 regardless.
+        """
+        step = self.latest_step() if resume else None
+        if step is not None:
+            state = self._restore(step)
+        else:
+            state = init_state(self.problem, self._a, self._b, self._rhs)
+
+        max_iter = self.problem.max_iterations
+        while True:
+            k = int(state[0])
+            done = (
+                bool(state[6]) or bool(state[7]) or k >= max_iter
+            )  # converged / breakdown / cap
+            if done:
+                break
+            state = self._advance(
+                state, jnp.asarray(k + self.chunk, jnp.int32)
+            )
+            self._save(state)
+        return result_of(state)
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def solve_with_checkpoints(
+    problem: Problem,
+    directory: str,
+    chunk: int = 500,
+    dtype=jnp.float32,
+    stencil: str = "xla",
+    resume: bool = True,
+) -> PCGResult:
+    """One-call form of CheckpointingSolver."""
+    with CheckpointingSolver(
+        problem, directory, chunk=chunk, dtype=dtype, stencil=stencil
+    ) as solver:
+        return solver.run(resume=resume)

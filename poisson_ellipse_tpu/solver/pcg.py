@@ -49,22 +49,52 @@ class PCGResult(NamedTuple):
     breakdown: jax.Array
 
 
-def pcg(problem: Problem, a, b, rhs, stencil: str = "xla"):
-    """Run PCG for pre-assembled coefficients. All inputs (M+1, N+1).
+def init_state(problem: Problem, a, b, rhs):
+    """The PCG carry at iteration 0 (the resumable solver state).
 
-    Jit-safe with ``problem`` static; the while_loop carries
-    (k, w, r, p, zr, diff, converged, breakdown) entirely on device.
+    Layout: (k, w, r, p, zr, diff, converged, breakdown) — everything the
+    loop needs to continue, so a saved state resumes bit-identically
+    (solver.checkpoint builds on this).
+    """
+    dtype = rhs.dtype
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    d = diag_d(a, b, h1, h2)
+    r0 = rhs
+    z0 = apply_dinv(r0, d)
+    zr0 = grid_dot(z0, r0, h1, h2)
+    return (
+        jnp.asarray(0, jnp.int32),
+        jnp.zeros_like(rhs),
+        r0,
+        z0,  # p0 = z0
+        zr0,
+        jnp.asarray(jnp.inf, dtype),
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
 
-    stencil: "xla" (padded-slice arithmetic, XLA-fused) or "pallas" (the
-    explicit VMEM-tiled kernel, ``ops.pallas_kernels.apply_a_pallas``).
-    The two agree to 1-2 ulps — not bitwise — so iteration counts may
-    differ by a step on ill-conditioned grids.
+
+def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"):
+    """Advance the PCG carry until convergence/breakdown or iteration
+    ``limit`` (defaults to max_iterations). Returns the new carry.
+
+    Running in chunks (limit=k, k+K, …) is bit-identical to one straight
+    run: chunking only moves the while_loop boundary, not the arithmetic.
     """
     dtype = rhs.dtype
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     delta = jnp.asarray(problem.delta, dtype)
-    max_iter = problem.max_iterations
+    # the bound may be a traced scalar (checkpointed runs pass k+chunk per
+    # dispatch without recompiling)
+    max_iter = (
+        problem.max_iterations
+        if limit is None
+        else jnp.minimum(
+            jnp.asarray(limit, jnp.int32), problem.max_iterations
+        )
+    )
     weighted = problem.norm == "weighted"
 
     if stencil == "pallas":
@@ -77,12 +107,6 @@ def pcg(problem: Problem, a, b, rhs, stencil: str = "xla"):
         raise ValueError(f"unknown stencil: {stencil!r}")
 
     d = diag_d(a, b, h1, h2)
-
-    w0 = jnp.zeros_like(rhs)
-    r0 = rhs
-    z0 = apply_dinv(r0, d)
-    p0 = z0
-    zr0 = grid_dot(z0, r0, h1, h2)
 
     def cond(state):
         k, _w, _r, _p, _zr, _diff, converged, breakdown = state
@@ -122,20 +146,32 @@ def pcg(problem: Problem, a, b, rhs, stencil: str = "xla"):
         zr_out = jnp.where(breakdown | converged, zr, zr_new)
         return (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
 
-    state0 = (
-        jnp.asarray(0, jnp.int32),
-        w0,
-        r0,
-        p0,
-        zr0,
-        jnp.asarray(jnp.inf, dtype),
-        jnp.asarray(False),
-        jnp.asarray(False),
+    return lax.while_loop(cond, body, state)
+
+
+def result_of(state) -> PCGResult:
+    """View a PCG carry as a PCGResult."""
+    k, w, _r, _p, _zr, diff, converged, breakdown = state
+    return PCGResult(
+        w=w, iters=k, diff=diff, converged=converged, breakdown=breakdown
     )
-    k, w, _r, _p, _zr, diff, converged, breakdown = lax.while_loop(
-        cond, body, state0
+
+
+def pcg(problem: Problem, a, b, rhs, stencil: str = "xla"):
+    """Run PCG for pre-assembled coefficients. All inputs (M+1, N+1).
+
+    Jit-safe with ``problem`` static; the while_loop carries
+    (k, w, r, p, zr, diff, converged, breakdown) entirely on device.
+
+    stencil: "xla" (padded-slice arithmetic, XLA-fused) or "pallas" (the
+    explicit VMEM-tiled kernel, ``ops.pallas_kernels.apply_a_pallas``).
+    The two agree to 1-2 ulps — not bitwise — so iteration counts may
+    differ by a step on ill-conditioned grids.
+    """
+    state = advance(
+        problem, a, b, rhs, init_state(problem, a, b, rhs), stencil=stencil
     )
-    return PCGResult(w=w, iters=k, diff=diff, converged=converged, breakdown=breakdown)
+    return result_of(state)
 
 
 def solve(problem: Problem, dtype=jnp.float32, stencil: str = "xla") -> PCGResult:

@@ -1,0 +1,99 @@
+"""Checkpoint/resume: chunked == straight, kill-and-resume, tamper guard."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.checkpoint import (
+    CheckpointingSolver,
+    solve_with_checkpoints,
+)
+from poisson_ellipse_tpu.solver.pcg import advance, init_state, pcg, result_of
+
+
+def test_chunked_advance_is_bit_identical_to_straight():
+    problem = Problem(M=20, N=20)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    straight = pcg(problem, a, b, rhs)
+
+    state = init_state(problem, a, b, rhs)
+    for limit in (7, 14, 21, 28, 100):
+        state = advance(problem, a, b, rhs, state, limit=limit)
+    chunked = result_of(state)
+
+    assert int(chunked.iters) == int(straight.iters) == 26
+    np.testing.assert_array_equal(
+        np.asarray(chunked.w), np.asarray(straight.w)
+    )
+
+
+def test_solve_with_checkpoints_matches_straight(tmp_path):
+    problem = Problem(M=20, N=20)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    # jitted straight run: the checkpointed path runs through jit too, and
+    # jit-vs-eager differ at the ulp level (fusion), which is not the
+    # property under test
+    straight = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))(a, b, rhs)
+    res = solve_with_checkpoints(
+        problem, str(tmp_path / "ck"), chunk=5, dtype=jnp.float64
+    )
+    assert int(res.iters) == int(straight.iters)
+    assert bool(res.converged)
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(straight.w), rtol=1e-12, atol=1e-16
+    )
+
+
+def test_resume_continues_from_disk(tmp_path):
+    problem = Problem(M=20, N=20)
+    directory = str(tmp_path / "ck")
+
+    # simulate a run killed mid-solve: advance one chunk, save, drop state
+    with CheckpointingSolver(
+        problem, directory, chunk=5, dtype=jnp.float64
+    ) as s1:
+        state = init_state(problem, s1._a, s1._b, s1._rhs)
+        state = s1._advance(state, jnp.asarray(5, jnp.int32))
+        s1._save(state)
+        assert s1.latest_step() == 5
+
+    with CheckpointingSolver(
+        problem, directory, chunk=5, dtype=jnp.float64
+    ) as s2:
+        res = s2.run(resume=True)
+
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    straight = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))(a, b, rhs)
+    assert int(res.iters) == int(straight.iters)
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(straight.w), rtol=1e-12, atol=1e-16
+    )
+
+
+def test_resume_false_ignores_checkpoints(tmp_path):
+    problem = Problem(M=10, N=10)
+    directory = str(tmp_path / "ck")
+    solve_with_checkpoints(problem, directory, chunk=4, dtype=jnp.float64)
+    res = solve_with_checkpoints(
+        problem, directory, chunk=4, dtype=jnp.float64, resume=False
+    )
+    assert bool(res.converged) and int(res.iters) == 15
+
+
+def test_mismatched_problem_is_refused(tmp_path):
+    directory = str(tmp_path / "ck")
+    solve_with_checkpoints(
+        Problem(M=10, N=10), directory, chunk=4, dtype=jnp.float64
+    )
+    with pytest.raises(ValueError, match="different problem"):
+        solve_with_checkpoints(
+            Problem(M=12, N=10), directory, chunk=4, dtype=jnp.float64
+        )
+
+
+def test_bad_chunk_rejected(tmp_path):
+    with pytest.raises(ValueError, match="chunk"):
+        CheckpointingSolver(Problem(M=10, N=10), str(tmp_path), chunk=0)
