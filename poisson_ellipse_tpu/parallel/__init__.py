@@ -16,11 +16,20 @@ exchange (``:241-347``, ``poisson_mpi_cuda2.cu:331-500``) and
               per iteration: one halo exchange (4 ppermutes) + two ``psum``
               collectives, vs the reference's 4 MPI_Sendrecv (with
               host-staged D2H/H2D copies) + 3 MPI_Allreduce + ≥3
-              device-host partial-sum round-trips.
+              device-host partial-sum round-trips,
+- ``multihost``: ``jax.distributed.initialize`` lifecycle (= MPI_Init/
+              Finalize) and the all-hosts global mesh — the same solver
+              code rides ICI within a slice and DCN across hosts.
 """
 
 from poisson_ellipse_tpu.parallel.mesh import choose_process_grid, make_mesh
 from poisson_ellipse_tpu.parallel.halo import halo_extend
+from poisson_ellipse_tpu.parallel.multihost import (
+    global_mesh,
+    initialize_multihost,
+    process_info,
+    shutdown_multihost,
+)
 from poisson_ellipse_tpu.parallel.pcg_sharded import (
     build_sharded_solver,
     solve_sharded,
@@ -32,4 +41,8 @@ __all__ = [
     "halo_extend",
     "build_sharded_solver",
     "solve_sharded",
+    "global_mesh",
+    "initialize_multihost",
+    "process_info",
+    "shutdown_multihost",
 ]

@@ -39,16 +39,29 @@ def _shift_hi_to_lo(edge, axis_name: str, n: int):
     return lax.ppermute(edge, axis_name, [(i + 1, i) for i in range(n - 1)])
 
 
-def halo_extend(u, px: int, py: int):
-    """Extend a local (bm, bn) block to (bm+2, bn+2) with neighbour halos.
+def halo_extend(u, px: int, py: int, width: int = 1):
+    """Extend a local (bm, bn) block to (bm+2w, bn+2w) with neighbour halos.
 
     Zeros appear wherever there is no neighbour (Dirichlet boundary /
     padding). One x-round then one y-round on the extended block, so the
-    four corner cells are correct after two rounds.
+    corner cells are correct after two rounds.
+
+    ``width`` generalises the 5-point stencil's 1-cell ring to w-cell
+    slabs — the same nearest-neighbour slab exchange that sequence/
+    context parallelism (ring attention) performs on sequence shards, so
+    this is the framework's reusable CP-style primitive (SURVEY §5);
+    wider stencils or multi-step fusion set width>1. Requires
+    width <= min(bm, bn).
     """
-    lo_x = _shift_lo_to_hi(u[-1:, :], AXIS_X, px)
-    hi_x = _shift_hi_to_lo(u[:1, :], AXIS_X, px)
+    if width < 1:
+        raise ValueError("halo width must be >= 1")
+    if width > min(u.shape):
+        raise ValueError(
+            f"halo width {width} exceeds block extent {min(u.shape)}"
+        )
+    lo_x = _shift_lo_to_hi(u[-width:, :], AXIS_X, px)
+    hi_x = _shift_hi_to_lo(u[:width, :], AXIS_X, px)
     u = jnp.concatenate([lo_x, u, hi_x], axis=0)
-    lo_y = _shift_lo_to_hi(u[:, -1:], AXIS_Y, py)
-    hi_y = _shift_hi_to_lo(u[:, :1], AXIS_Y, py)
+    lo_y = _shift_lo_to_hi(u[:, -width:], AXIS_Y, py)
+    hi_y = _shift_hi_to_lo(u[:, :width], AXIS_Y, py)
     return jnp.concatenate([lo_y, u, hi_y], axis=1)

@@ -37,9 +37,13 @@ from poisson_ellipse_tpu.solver.pcg import (
 STATE_KEYS = ("k", "w", "r", "p", "zr", "diff", "converged", "breakdown")
 
 
-def _fingerprint(problem: Problem, dtype) -> dict:
+def _fingerprint(problem: Problem, dtype, stencil: str) -> dict:
     fp = dataclasses.asdict(problem)
     fp["dtype"] = str(jnp.dtype(dtype))
+    # the xla and pallas stencils agree only to 1-2 ulps, so resuming a
+    # run under the other operator would be a silent mixed-arithmetic
+    # solve — fingerprint it like the discretisation itself
+    fp["stencil"] = stencil
     return fp
 
 
@@ -76,7 +80,7 @@ class CheckpointingSolver:
         self.dtype = dtype
         self.stencil = stencil
         self.directory = os.path.abspath(directory)
-        self._fp = _fingerprint(problem, dtype)
+        self._fp = _fingerprint(problem, dtype, stencil)
         self._manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(

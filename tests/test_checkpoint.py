@@ -97,3 +97,18 @@ def test_mismatched_problem_is_refused(tmp_path):
 def test_bad_chunk_rejected(tmp_path):
     with pytest.raises(ValueError, match="chunk"):
         CheckpointingSolver(Problem(M=10, N=10), str(tmp_path), chunk=0)
+
+
+def test_mismatched_stencil_is_refused(tmp_path):
+    directory = str(tmp_path / "ck")
+    solve_with_checkpoints(
+        Problem(M=10, N=10), directory, chunk=4, dtype=jnp.float64
+    )
+    with pytest.raises(ValueError, match="different problem"):
+        solve_with_checkpoints(
+            Problem(M=10, N=10),
+            directory,
+            chunk=4,
+            dtype=jnp.float64,
+            stencil="pallas",
+        )

@@ -123,3 +123,69 @@ def test_sharded_l2_error_matches():
     got = solve_sharded(problem, mesh_of(8), jnp.float64)
     err = float(l2_error_vs_analytic(problem, got.w))
     assert err == pytest.approx(3.677e-3, rel=1e-3)
+
+
+def test_halo_extend_wider_width():
+    """width>1 slab exchange (the CP-analog primitive, SURVEY §5)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
+
+    mesh = make_mesh(jax.devices()[:4])
+    px, py = mesh.shape[AXIS_X], mesh.shape[AXIS_Y]
+    bm, bn = 6, 6
+    global_u = jnp.arange(px * bm * py * bn, dtype=jnp.float64).reshape(
+        px * bm, py * bn
+    )
+    width = 2
+    spec = P(AXIS_X, AXIS_Y)
+    ext = jax.jit(
+        jax.shard_map(
+            lambda u: halo_extend(u, px, py, width=width),
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
+        )
+    )(global_u)
+    ext = np.asarray(ext)
+    # device (0,0)'s extended block sits at rows 0..bm+2w of the stacked
+    # output; its interior must match, its high-x halo must equal the
+    # first `width` rows of device (1,0)'s block, and the boundary side
+    # must be zero
+    blk = ext[: bm + 2 * width, : bn + 2 * width]
+    np.testing.assert_array_equal(
+        blk[width:-width, width:-width], np.asarray(global_u[:bm, :bn])
+    )
+    np.testing.assert_array_equal(
+        blk[-width:, width:-width], np.asarray(global_u[bm : bm + width, :bn])
+    )
+    np.testing.assert_array_equal(blk[:width, :], np.zeros((width, bn + 2 * width)))
+
+
+def test_halo_extend_rejects_bad_width():
+    with pytest.raises(ValueError, match="width"):
+        halo_extend(jnp.zeros((4, 4)), 1, 1, width=0)
+    with pytest.raises(ValueError, match="width"):
+        halo_extend(jnp.zeros((4, 4)), 1, 1, width=5)
+
+
+def test_multihost_helpers_single_process():
+    """Single-process semantics of the MPI-lifecycle analogs."""
+    from poisson_ellipse_tpu.parallel.multihost import (
+        global_mesh,
+        process_info,
+    )
+
+    pid, nproc = process_info()
+    assert pid == 0 and nproc == 1
+    mesh = global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_initialize_multihost_idempotent_guard():
+    """The is_initialized() guard path (single-process: not initialised)."""
+    from poisson_ellipse_tpu.parallel.multihost import shutdown_multihost
+
+    # not initialised -> shutdown is a no-op rather than an error
+    shutdown_multihost()
