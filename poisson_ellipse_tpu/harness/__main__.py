@@ -48,8 +48,14 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--mode",
-        choices=("auto", "single", "sharded"),
+        choices=("auto", "single", "sharded", "native"),
         default="auto",
+    )
+    ap.add_argument(
+        "--threads",
+        type=int,
+        default=0,
+        help="OpenMP thread count for --mode native (0 = default)",
     )
     ap.add_argument(
         "--mesh",
@@ -125,12 +131,19 @@ def main(argv=None) -> int:
                         dtype=args.dtype,
                         repeat=args.repeat,
                         batch=args.batch,
+                        threads=args.threads,
                     )
             except ValueError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
             phases = None
-            if args.profile:
+            if args.profile and args.mode == "native":
+                print(
+                    "note: --profile covers the JAX paths; skipped for "
+                    "--mode native",
+                    file=sys.stderr,
+                )
+            elif args.profile:
                 from poisson_ellipse_tpu.harness.profile import (
                     profile_single,
                     profile_sharded,

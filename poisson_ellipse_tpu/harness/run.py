@@ -135,16 +135,23 @@ def run_once(
     dtype: str = "f32",
     repeat: int = 1,
     batch: int = 1,
+    threads: int = 0,
 ) -> RunReport:
     """Assemble + solve with fenced init/solver timing.
 
     mode:  "single" — single-device solver (stage0/1/4-1GPU analog);
            "sharded" — mesh-sharded solver (stage2/3/4 analog);
+           "native" — the C++/OpenMP host runtime (stage0/1 natively;
+                      always f64; ``threads`` selects the OpenMP count;
+                      T_solver includes assembly, exactly as the
+                      reference's stage0 chrono wraps its whole solve());
            "auto" — sharded iff >1 device or an explicit mesh is requested.
     repeat/batch: timing protocol — ``repeat`` measurements of ``batch``
     back-to-back dispatches each (batch>1 amortises host↔device RTT on
     tunneled backends); T_solver is the median over measurements.
     """
+    if mode == "native":
+        return _run_native(problem, repeat=repeat, threads=threads)
     jdtype = resolve_dtype(dtype)
     if mode == "auto":
         mode = (
@@ -198,5 +205,32 @@ def run_once(
         l2_error=l2,
         t_init=timer.totals["init"],
         t_solver=timer.totals["solver"],
+        times=times,
+    )
+
+
+def _run_native(problem: Problem, repeat: int, threads: int) -> RunReport:
+    import jax.numpy as jnp
+
+    from poisson_ellipse_tpu.runtime import solve_native
+
+    times = []
+    result = None
+    for _ in range(max(repeat, 1)):
+        t0 = time.perf_counter()
+        result = solve_native(problem, threads=threads)
+        times.append(time.perf_counter() - t0)
+    l2 = float(l2_error_vs_analytic(problem, jnp.asarray(result.w)))
+    return RunReport(
+        problem=problem,
+        mesh_shape=(1, 1),
+        dtype="f64",
+        iters=result.iters,
+        converged=result.converged,
+        breakdown=result.breakdown,
+        diff=result.diff,
+        l2_error=l2,
+        t_init=0.0,
+        t_solver=statistics.median(times),
         times=times,
     )

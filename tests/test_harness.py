@@ -105,3 +105,14 @@ def test_profile_single_phases():
     assert all(v >= 0.0 for v in phases.values())
     text = format_phases(phases, iters=10)
     assert "t_stencil" in text and "x10 iters" in text
+
+
+def test_cli_native_backend(capsys):
+    from poisson_ellipse_tpu.runtime import native_available
+
+    if not native_available():
+        pytest.skip("C++ runtime unavailable")
+    rc = cli_main(["40", "40", "--mode", "native", "--threads", "1", "--json"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["iters"] == 50 and rec["dtype"] == "f64"
