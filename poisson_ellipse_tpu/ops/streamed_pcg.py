@@ -1,0 +1,420 @@
+"""Tile-wise whole-solve kernel for grids beyond full VMEM residency.
+
+``ops.resident_pcg`` holds every operand and iterate in VMEM, but its
+whole-array expressions make Mosaic materialise full-size temporaries,
+capping it at ~1000x1500. This kernel removes that cap two ways:
+
+- **tile-wise compute**: every sweep walks row tiles, so temporaries are
+  tile-sized and the only full-size VMEM consumers are the arrays we
+  *choose* to keep resident;
+- **per-operand residency**: the PCG state (w, r, p) always stays in
+  VMEM scratch across the whole ``lax.while_loop`` (the entire point —
+  state never touches HBM); each loop-invariant operand (Dinv, a, b) and
+  the ap intermediate is either VMEM-resident too (loaded once) or
+  streamed per tile from HBM with ``make_async_copy`` double-buffering,
+  chosen greedily to fill the measured ~127 MB of VMEM.
+
+On the bench chip this makes 1600x2400 all-resident (zero HBM bytes per
+iteration) and 2400x3200 stream only Dinv and ap (~6 array-passes/iter
+vs the ~13 the XLA while_loop streams once the working set outgrows
+VMEM) — the two reference grids where the XLA path is HBM-bound.
+
+Per iteration, three tile sweeps inside one kernel:
+
+  A   p <- r*Dinv + beta*p                       (rotated p-update)
+  B   ap = A(p) tile-by-tile; denom partial      (stencil + dot)
+  C   alpha; w += alpha*p; r -= alpha*ap;
+      ||dw||^2 and (z, r) partials               (fused updates)
+
+The stencil uses the reference's exact floating-point form (each
+difference divided by h before combining, ``stage0/Withoutopenmp1.cpp:
+75-88``) with the f64-rounded operand set, preserving the published
+iteration-count oracles in f32. The preconditioner is a multiply by the
+precomputed guarded 1/D (f64-rounded), as in ``ops.fused_pcg``.
+
+p's scratch carries 8-row zero bands above and below the grid so the
+stencil's row-neighbour reads are always in bounds; ring/padding output
+rows are masked in-kernel (assembled coefficients are nonzero *adjacent*
+to the ring, so masking inputs alone cannot zero the ring output —
+same reason the reference's kernels guard on indices,
+``poisson_mpi_cuda2.cu:512-516``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
+
+_VMEM_LIMIT = 127 * 1024 * 1024
+_VMEM_USABLE = 114 * 1024 * 1024  # leave headroom for Mosaic temps
+_BAND = 8  # zero band rows above/below the p scratch
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+class StreamPlan:
+    """Which operands stay VMEM-resident, plus the tiling."""
+
+    def __init__(self, problem: Problem, dtype):
+        g1, g2 = problem.node_shape
+        self.g2p = _round_up(g2, 128)
+        self.tm = 64 if g1 >= 64 else _round_up(g1, 8)
+        self.g1p = _round_up(g1, self.tm)
+        self.n_tiles = self.g1p // self.tm
+        item = jnp.dtype(dtype).itemsize
+        arr = self.g1p * self.g2p * item
+        budget = _VMEM_USABLE
+        # state is always resident: w, r + p with its zero bands
+        budget -= 3 * arr + 2 * _BAND * self.g2p * item
+        # greedy residency, highest streamed-passes-saved first:
+        # dinv is read twice per iteration, ap written+read once each
+        self.resident = {}
+        for name, cost in (("dinv", arr), ("ap", arr),
+                           ("a", arr + 8 * self.g2p * item), ("b", arr)):
+            take = cost + 16 * self.g2p * item <= budget
+            self.resident[name] = take
+            if take:
+                budget -= cost
+
+    def streamed_passes_per_iter(self) -> float:
+        """HBM array-passes per iteration (for the roofline report)."""
+        p = 0.0
+        if not self.resident["dinv"]:
+            p += 2.0
+        if not self.resident["ap"]:
+            p += 2.0
+        if not self.resident["a"]:
+            p += 1.0 + 8.0 / self.tm
+        if not self.resident["b"]:
+            p += 1.0
+        return p
+
+
+def _shift_cols_right(x):
+    zero = jnp.zeros((x.shape[0], 1), x.dtype)
+    return jnp.concatenate([zero, x[:, :-1]], axis=1)
+
+
+def _shift_cols_left(x):
+    zero = jnp.zeros((x.shape[0], 1), x.dtype)
+    return jnp.concatenate([x[:, 1:], zero], axis=1)
+
+
+def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
+                 # HBM / maybe-VMEM inputs
+                 dinv_hbm, a_hbm, b_hbm, r0_hbm,
+                 # outputs
+                 w_out, iters_out, diff_out, flags_out, ap_hbm,
+                 # scratch
+                 w_s, r_s, p_s, dinv_buf, a_buf, b_buf, ap_buf, sems):
+    dtype = r0_hbm.dtype
+    tm, g2p, n_tiles = plan.tm, plan.g2p, plan.n_tiles
+    h1 = float(problem.h1)
+    h2 = float(problem.h2)
+    h1h2 = jnp.asarray(h1 * h2, dtype)
+    delta = jnp.asarray(problem.delta, dtype)
+    max_iter = problem.max_iterations
+    M, N = problem.M, problem.N
+    res = plan.resident
+
+    # -- residency helpers -------------------------------------------------
+    # serial copies: start+wait around each tile (the streamed arrays are
+    # a small fraction of iteration time; see module docstring)
+
+    def load(hbm, buf, sem, t, rows):
+        cp = pltpu.make_async_copy(
+            hbm.at[pl.ds(t * tm, rows), :], buf.at[pl.ds(0, rows), :], sem
+        )
+        cp.start()
+        cp.wait()
+        return buf
+
+    def dinv_tile(t):
+        if res["dinv"]:
+            return dinv_buf[pl.ds(t * tm, tm), :]
+        return load(dinv_hbm, dinv_buf, sems.at[0], t, tm)[0:tm, :]
+
+    def a_win(t):
+        """Rows t0 .. t0+tm (tm+1 rows; buffer is tm+8-aligned)."""
+        if res["a"]:
+            return a_buf[pl.ds(t * tm, tm + 1), :]
+        return load(a_hbm, a_buf, sems.at[1], t, tm + 8)[0 : tm + 1, :]
+
+    def b_tile(t):
+        if res["b"]:
+            return b_buf[pl.ds(t * tm, tm), :]
+        return load(b_hbm, b_buf, sems.at[2], t, tm)[0:tm, :]
+
+    def ap_store(t, val):
+        if res["ap"]:
+            ap_buf[pl.ds(t * tm, tm), :] = val
+        else:
+            ap_buf[...] = val
+            cp = pltpu.make_async_copy(
+                ap_buf, ap_hbm.at[pl.ds(t * tm, tm), :], sems.at[3]
+            )
+            cp.start()
+            cp.wait()
+
+    def ap_load(t):
+        if res["ap"]:
+            return ap_buf[pl.ds(t * tm, tm), :]
+        cp = pltpu.make_async_copy(
+            ap_hbm.at[pl.ds(t * tm, tm), :], ap_buf, sems.at[3]
+        )
+        cp.start()
+        cp.wait()
+        return ap_buf[...]
+
+    # -- one-time initialisation ------------------------------------------
+    for name, hbm, buf, rows in (
+        ("dinv", dinv_hbm, dinv_buf, plan.g1p),
+        ("a", a_hbm, a_buf, plan.g1p + 8),
+        ("b", b_hbm, b_buf, plan.g1p),
+    ):
+        if res[name]:
+            cp = pltpu.make_async_copy(hbm, buf, sems.at[0])
+            cp.start()
+            cp.wait()
+
+    w_s[...] = jnp.zeros(w_s.shape, dtype)
+    p_s[...] = jnp.zeros(p_s.shape, dtype)
+    cp = pltpu.make_async_copy(r0_hbm, r_s, sems.at[0])
+    cp.start()
+    cp.wait()
+
+    def tile_sum(fold):
+        def body(t, acc):
+            return acc + fold(t)
+        return lax.fori_loop(0, n_tiles, body, jnp.zeros((), dtype))
+
+    zr0 = tile_sum(
+        lambda t: jnp.sum(
+            (r_s[pl.ds(t * tm, tm), :] * dinv_tile(t))
+            * r_s[pl.ds(t * tm, tm), :]
+        )
+    ) * h1h2
+
+    # -- the stencil for one tile -----------------------------------------
+    def stencil_tile(t):
+        """A(p) on tile t, reference FP form, ring/padding masked.
+
+        Row neighbours come from aligned 8-row block loads + value-level
+        concats: Mosaic requires dynamic VMEM loads at sublane multiples,
+        so a tile shifted by one row is not directly loadable.
+        """
+        pc = p_s[pl.ds(_BAND + t * tm, tm), :]
+        p_above = p_s[pl.ds(_BAND + t * tm - 8, 8), :]
+        p_below = p_s[pl.ds(_BAND + (t + 1) * tm, 8), :]
+        pu = jnp.concatenate([p_above[7:8, :], pc[:-1]], axis=0)
+        pd = jnp.concatenate([pc[1:], p_below[0:1, :]], axis=0)
+        aw = a_win(t)
+        ac = aw[0:tm, :]
+        ad = aw[1 : tm + 1, :]
+        bc = b_tile(t)
+        br = _shift_cols_left(bc)
+        pl_ = _shift_cols_right(pc)
+        pr = _shift_cols_left(pc)
+        ax = -(ad * (pd - pc) / h1 - ac * (pc - pu) / h1) / h1
+        ay = -(br * (pr - pc) / h2 - bc * (pc - pl_) / h2) / h2
+        gi = t * tm + lax.broadcasted_iota(jnp.int32, (tm, g2p), 0)
+        gj = lax.broadcasted_iota(jnp.int32, (tm, g2p), 1)
+        interior = (gi >= 1) & (gi <= M - 1) & (gj >= 1) & (gj <= N - 1)
+        apt = jnp.where(interior, ax + ay, jnp.zeros_like(pc))
+        return apt, pc
+
+    # -- the while loop ----------------------------------------------------
+    carry0 = (
+        jnp.asarray(0, jnp.int32), zr0,
+        jnp.asarray(0.0, dtype),            # beta
+        jnp.asarray(jnp.inf, dtype),        # diff
+        jnp.asarray(False), jnp.asarray(False),
+    )
+
+    def cond(c):
+        k, _zr, _b, _d, conv, bd = c
+        return (k < max_iter) & ~conv & ~bd
+
+    def body(c):
+        k, zr, beta, diff, _cv, _bd = c
+
+        # pass A: p <- r*Dinv + beta*p
+        def pass_a(t, _):
+            rows = pl.ds(_BAND + t * tm, tm)
+            p_s[rows, :] = (
+                r_s[pl.ds(t * tm, tm), :] * dinv_tile(t)
+                + beta * p_s[rows, :]
+            )
+            return 0
+        lax.fori_loop(0, n_tiles, pass_a, 0)
+
+        # pass B: ap = A(p), denom
+        def pass_b(t, acc):
+            apt, pc = stencil_tile(t)
+            ap_store(t, apt)
+            return acc + jnp.sum(apt * pc)
+        denom = lax.fori_loop(
+            0, n_tiles, pass_b, jnp.zeros((), dtype)
+        ) * h1h2
+
+        breakdown = denom < DENOM_GUARD
+        alpha = zr / jnp.where(breakdown, jnp.ones_like(denom), denom)
+        alpha = jnp.where(breakdown, jnp.zeros_like(alpha), alpha)
+
+        # pass C: fused updates + both reductions
+        def pass_c(t, acc):
+            dw2a, zra = acc
+            rows = pl.ds(t * tm, tm)
+            w = w_s[rows, :]
+            w_new = w + alpha * p_s[pl.ds(_BAND + t * tm, tm), :]
+            dw = w_new - w
+            w_s[rows, :] = w_new
+            r_new = r_s[rows, :] - alpha * ap_load(t)
+            r_s[rows, :] = r_new
+            return (
+                dw2a + jnp.sum(dw * dw),
+                zra + jnp.sum((r_new * dinv_tile(t)) * r_new),
+            )
+        dw2, zr_raw = lax.fori_loop(
+            0, n_tiles, pass_c,
+            (jnp.zeros((), dtype), jnp.zeros((), dtype)),
+        )
+        zr_new = zr_raw * h1h2
+
+        ndiff = jnp.sqrt(dw2 * h1h2) if weighted else jnp.sqrt(dw2)
+        conv = ~breakdown & (ndiff < delta)
+        ndiff = jnp.where(breakdown, diff, ndiff)
+        beta_new = jnp.where(breakdown, beta, zr_new / zr)
+        zr_out = jnp.where(breakdown, zr, zr_new)
+        return (k + 1, zr_out, beta_new, ndiff, conv, breakdown)
+
+    out = lax.while_loop(cond, body, carry0)
+
+    cp = pltpu.make_async_copy(w_s, w_out, sems.at[0])
+    cp.start()
+    cp.wait()
+    iters_out[0] = out[0]
+    diff_out[0] = out[3]
+    flags_out[0] = out[4].astype(jnp.int32)
+    flags_out[1] = out[5].astype(jnp.int32)
+
+
+def build_streamed_solver(problem: Problem, dtype=jnp.float32,
+                          interpret=None):
+    """(jitted whole-solve kernel, args) for large grids.
+
+    args = (dinv, a, b, r0), all f64-assembled and rounded once (same
+    operand fidelity as ``fused_pcg.build_fused_solver``).
+    """
+    import numpy as np
+
+    if jnp.dtype(dtype).itemsize >= 8:
+        raise ValueError("streamed solver supports f32/bf16")
+    if interpret is None:
+        interpret = _interpret_default()
+    g1, g2 = problem.node_shape
+    plan = StreamPlan(problem, dtype)
+    g1p, g2p, tm = plan.g1p, plan.g2p, plan.tm
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+
+    a64, b64, rhs64 = assembly.assemble_numpy(problem)
+
+    def padded(x, extra_rows=0):
+        return jnp.asarray(
+            np.pad(
+                x, ((0, g1p + extra_rows - x.shape[0]), (0, g2p - x.shape[1]))
+            ).astype(np_dtype)
+        )
+
+    # guarded 1/D from the f64 diagonal (an + as + bw + be)
+    ih1 = 1.0 / (problem.h1 * problem.h1)
+    ih2 = 1.0 / (problem.h2 * problem.h2)
+    an = a64 * ih1
+    as_ = np.roll(an, -1, axis=0)
+    bw = b64 * ih2
+    be = np.roll(bw, -1, axis=1)
+    gi = np.arange(g1)[:, None]
+    gj = np.arange(g2)[None, :]
+    interior = (
+        (gi >= 1) & (gi <= problem.M - 1) & (gj >= 1) & (gj <= problem.N - 1)
+    )
+    d64 = np.where(interior, an + as_ + bw + be, 0.0)
+    dinv64 = np.where(d64 != 0.0, 1.0 / np.where(d64 != 0.0, d64, 1.0), 0.0)
+
+    args = (padded(dinv64), padded(a64, 8), padded(b64), padded(rhs64))
+
+    kernel = functools.partial(
+        _mega_kernel, problem, plan, problem.norm == "weighted"
+    )
+    anyspec = lambda: pl.BlockSpec(memory_space=pl.ANY)
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    res = plan.resident
+    buf = lambda name, rows, extra=0: (
+        pltpu.VMEM((g1p + extra, g2p), dtype)
+        if res[name]
+        else pltpu.VMEM((rows + extra, g2p), dtype)
+    )
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[anyspec()] * 4,
+        out_specs=(anyspec(), smem(), smem(), smem(), anyspec()),
+        out_shape=(
+            jax.ShapeDtypeStruct((g1p, g2p), dtype),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((1,), dtype),
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+            # HBM scratch for ap when it is not VMEM-resident (an output
+            # only because pallas scratch cannot live in HBM)
+            jax.ShapeDtypeStruct(
+                (8, g2p) if res["ap"] else (g1p, g2p), dtype
+            ),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g1p, g2p), dtype),             # w
+            pltpu.VMEM((g1p, g2p), dtype),             # r
+            pltpu.VMEM((g1p + 2 * _BAND, g2p), dtype),  # p with bands
+            buf("dinv", tm),
+            buf("a", tm, 8),
+            buf("b", tm),
+            (pltpu.VMEM((g1p, g2p), dtype)
+             if res["ap"] else pltpu.VMEM((tm, g2p), dtype)),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT
+        ),
+        interpret=interpret,
+    )
+
+    def solver(dinv, a, b, r0):
+        w_pad, iters, diff, flags, _ap = call(dinv, a, b, r0)
+        return PCGResult(
+            w=w_pad[:g1, :g2],
+            iters=iters[0],
+            diff=diff[0],
+            converged=flags[0].astype(bool),
+            breakdown=flags[1].astype(bool),
+        )
+
+    return jax.jit(solver), args
+
+
+def solve_streamed(problem: Problem, dtype=jnp.float32,
+                   interpret=None) -> PCGResult:
+    solver, args = build_streamed_solver(problem, dtype, interpret=interpret)
+    return solver(*args)

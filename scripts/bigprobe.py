@@ -1,0 +1,45 @@
+import time, jax, jax.numpy as jnp
+from jax import lax
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.fused_pcg import build_fused_solver
+from poisson_ellipse_tpu.solver.pcg import pcg
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_run(f, args, reps=4):
+    out = f(*args); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = f(*args); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+def per_solve(make_chained, args, n=5):
+    t1 = t_run(make_chained(1), args)
+    tn = t_run(make_chained(n), args)
+    return (tn - t1) / (n - 1)
+
+for (M, N, oracle) in [(1600,2400,1858),(2400,3200,2449)]:
+    prob = Problem(M=M, N=N)
+    a, b, rhs = assembly.assemble(prob, jnp.float32)
+    def xchained(n):
+        def g(a_, b_, rhs_):
+            def one(i, acc):
+                res = pcg(prob, a_, b_, rhs_ * (1.0 + 1e-12 * acc))
+                return acc + res.diff
+            return lax.fori_loop(0, n, one, jnp.float32(0.0))
+        return jax.jit(g)
+    xt = per_solve(xchained, (a, b, rhs))
+
+    solver, fargs = build_fused_solver(prob, jnp.float32)
+    def fchained(n):
+        def g(*ops):
+            r0 = ops[-1]
+            def one(i, acc):
+                res = solver(*ops[:-1], r0 * (1.0 + 1e-12 * acc))
+                return acc + res.diff
+            return lax.fori_loop(0, n, one, jnp.float32(0.0))
+        return jax.jit(g)
+    ft = per_solve(fchained, fargs)
+    print(f"{M}x{N}: XLA {xt:.4f}s ({xt/oracle*1e6:.1f} us/it) | "
+          f"fused {ft:.4f}s ({ft/oracle*1e6:.1f} us/it) | ratio {xt/ft:.2f}x")

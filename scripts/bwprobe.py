@@ -1,0 +1,24 @@
+import time, jax, jax.numpy as jnp
+from jax import lax
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_chain(step, x0, n, reps=3):
+    f = jax.jit(lambda x: lax.fori_loop(0, n, lambda i, s: step(s, i), x))
+    out = f(x0); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = f(x0); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+for (M, N) in [(801, 1201), (1601, 2401), (2401, 3201)]:
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (M, N), jnp.float32)
+    p = jax.random.normal(key, (M, N), jnp.float32)
+    MB = M*N*4/1e6
+    def saxpy(s, i):
+        return s + (1e-6*(i.astype(jnp.float32)+1.0)) * p
+    n1, n2 = 200, 2000
+    t1, t2 = t_chain(saxpy, w, n1), t_chain(saxpy, w, n2)
+    per = (t2-t1)/(n2-n1)
+    print(f"{M}x{N} saxpy(3-pass): {per*1e6:.1f} us/iter -> {3*MB/per/1e3:.0f} GB/s")

@@ -1,0 +1,25 @@
+"""Back-to-back XLA vs fused comparison on the reference grids."""
+import time, jax, jax.numpy as jnp
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.fused_pcg import build_fused_solver
+from poisson_ellipse_tpu.solver.pcg import pcg
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_run(f, args, reps=5):
+    out = f(*args); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = f(*args); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+for (M, N, oracle) in [(400,600,546),(800,1200,989),(1600,2400,1858),(2400,3200,2449)]:
+    prob = Problem(M=M, N=N)
+    a, b, rhs = assembly.assemble(prob, jnp.float32)
+    fx = jax.jit(lambda a, b, rhs: pcg(prob, a, b, rhs))
+    tx, ox = t_run(fx, (a, b, rhs))
+    ff, fargs = build_fused_solver(prob, jnp.float32)
+    tf, of = t_run(ff, fargs)
+    print(f"{M}x{N}: XLA {tx:.4f}s ({int(ox.iters)}it) | fused {tf:.4f}s "
+          f"({int(of.iters)}it, oracle {oracle}) | ratio {tx/tf:.2f}x")

@@ -1,0 +1,27 @@
+import time, jax, jax.numpy as jnp
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import pcg
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_solve(problem, a, b, rhs, n_iter, reps=3):
+    p2 = Problem(M=problem.M, N=problem.N, max_iter=n_iter)
+    f = jax.jit(lambda a, b, rhs: pcg(p2, a, b, rhs))
+    out = f(a, b, rhs); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = f(a, b, rhs); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+for (M, N, oracle) in [(400,600,546),(800,1200,989),(1600,2400,1858),(2400,3200,2449)]:
+    prob = Problem(M=M, N=N)
+    a, b, rhs = assembly.assemble(prob, jnp.float32)
+    n1, n2 = oracle // 5, oracle - 10
+    t1 = t_solve(prob, a, b, rhs, n1)
+    t2 = t_solve(prob, a, b, rhs, n2)
+    per = (t2 - t1) / (n2 - n1)
+    mb = (M+1)*(N+1)*4/1e6
+    print(f"{M}x{N}: t({n1})={t1:.4f} t({n2})={t2:.4f} -> {per*1e6:.1f} us/iter "
+          f"= {per*1e6*819e9*1e-12/mb:.1f} passes @819GB/s (array={mb:.2f}MB)")

@@ -1,0 +1,48 @@
+import time, functools, jax, jax.numpy as jnp
+from jax import lax
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.fused_pcg import build_kernels, fused_operands, _pad
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.stencil import apply_a
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_chain(step, x0, n, reps=3):
+    f = jax.jit(lambda x: lax.fori_loop(0, n, lambda i, s: step(s, i), x))
+    out = f(x0); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = f(x0); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+def per_iter(step, x0, n1=100, n2=600):
+    t1 = t_chain(step, x0, n1); t2 = t_chain(step, x0, n2)
+    return (t2 - t1) / (n2 - n1)
+
+for (M, N) in [(1600,2400),(2400,3200)]:
+    prob = Problem(M=M, N=N)
+    g1, g2 = prob.node_shape
+    kern = build_kernels(prob, g1, g2, jnp.float32)
+    an, as_, bw, be, d_p, dinv_p = fused_operands(prob, kern.g1p, kern.g2p, jnp.float32)
+    a, b, rhs = assembly.assemble(prob, jnp.float32)
+    r0 = _pad(rhs, kern.g1p, kern.g2p)
+    z0 = r0 * dinv_p
+    h1 = jnp.float32(prob.h1); h2 = jnp.float32(prob.h2)
+
+    def k1_step(state, i):
+        z, p = state
+        beta = 1e-3 * (i.astype(jnp.float32) + 1)
+        pn, ap, dn = kern.k1(beta, z, p, an, as_, bw, be, d_p)
+        return (p, pn)   # keep data-dependence
+    def k2_step(state, i):
+        w, r = state
+        alpha = jnp.float32(1e-3) * (i.astype(jnp.float32) + 1)
+        w2, r2, z2, sums = kern.k2(jnp.float32(1.0), alpha, w, r, z0, z0, dinv_p)
+        return (w2, r2)
+    def xla_stencil_step(u, i):
+        return apply_a(u, a, b, h1, h2) + 1e-9 * i.astype(jnp.float32)
+
+    print(f"{M}x{N} (tile rows g1p={kern.g1p}, g2p={kern.g2p}):")
+    print(f"  K1: {per_iter(k1_step, (z0, r0))*1e6:.1f} us")
+    print(f"  K2: {per_iter(k2_step, (r0, z0))*1e6:.1f} us")
+    print(f"  XLA stencil: {per_iter(xla_stencil_step, rhs)*1e6:.1f} us")

@@ -1,0 +1,22 @@
+import time, jax, jax.numpy as jnp
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.resident_pcg import build_resident_solver, fits_resident
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_run(f, args, reps=5):
+    out = f(*args); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = f(*args); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+for (M, N, oracle) in [(40,40,60),(400,600,546),(800,1200,989),(1024,1024,None)]:
+    prob = Problem(M=M, N=N)
+    if not fits_resident(prob):
+        print(f"{M}x{N}: does not fit resident budget"); continue
+    f, args = build_resident_solver(prob, jnp.float32)
+    t, out = t_run(f, args)
+    it = int(out.iters)
+    print(f"{M}x{N}: resident {t:.4f}s iters={it} (oracle {oracle}) "
+          f"conv={bool(out.converged)} -> {t/it*1e6:.1f} us/iter(incl dispatch)")

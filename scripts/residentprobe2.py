@@ -1,0 +1,21 @@
+import time, jax, jax.numpy as jnp
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.resident_pcg import build_resident_solver
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_run(f, args, reps=5):
+    out = f(*args); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = f(*args); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+for (M, N, oracle) in [(400,600,546),(800,1200,989),(1024,1024,921)]:
+    ts = {}
+    for ni, n in ((1, oracle//5), (2, oracle-10)):
+        prob = Problem(M=M, N=N, max_iter=n)
+        f, args = build_resident_solver(prob, jnp.float32)
+        ts[ni] = t_run(f, args)
+    per = (ts[2]-ts[1])/((oracle-10)-(oracle//5))
+    print(f"{M}x{N}: {per*1e6:.2f} us/iter  (t1={ts[1]:.4f} t2={ts[2]:.4f})")

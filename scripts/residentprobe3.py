@@ -1,0 +1,48 @@
+import time, jax, jax.numpy as jnp
+from jax import lax
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.resident_pcg import build_resident_solver
+from poisson_ellipse_tpu.solver.pcg import pcg
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_run(f, args, reps=4):
+    out = f(*args); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = f(*args); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+def chain_solver(build, n):
+    """Run the solve n times with a data dependence between runs."""
+    solver, args = build()
+    def chained(*a):
+        r0 = a[-1]
+        def one(i, acc):
+            res = solver(*a[:-1], r0 * (1.0 + 1e-12 * acc))
+            return acc + res.diff
+        acc = lax.fori_loop(0, n, one, jnp.float32(0.0))
+        return acc
+    return jax.jit(chained), args
+
+for (M, N, oracle) in [(400,600,546),(800,1200,989),(1024,1024,921)]:
+    prob = Problem(M=M, N=N)
+    # resident path
+    f1, a1 = chain_solver(lambda: build_resident_solver(prob, jnp.float32), 1)
+    f9, _ = chain_solver(lambda: build_resident_solver(prob, jnp.float32), 9)
+    t1, _ = t_run(f1, a1); t9, _ = t_run(f9, a1)
+    per_solve = (t9 - t1) / 8
+    # XLA path same protocol
+    a, b, rhs = assembly.assemble(prob, jnp.float32)
+    def xchained(n):
+        def g(a_, b_, rhs_):
+            def one(i, acc):
+                res = pcg(prob, a_, b_, rhs_ * (1.0 + 1e-12 * acc))
+                return acc + res.diff
+            return lax.fori_loop(0, n, one, jnp.float32(0.0))
+        return jax.jit(g)
+    tx1, _ = t_run(xchained(1), (a, b, rhs)); tx9, _ = t_run(xchained(9), (a, b, rhs))
+    xper = (tx9 - tx1) / 8
+    print(f"{M}x{N}: resident {per_solve:.4f}s/solve ({per_solve/oracle*1e6:.2f} us/iter) | "
+          f"XLA {xper:.4f}s/solve ({xper/oracle*1e6:.2f} us/iter) | speedup {xper/per_solve:.1f}x")

@@ -1,0 +1,37 @@
+import time, jax, jax.numpy as jnp
+from jax import lax
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.streamed_pcg import build_streamed_solver, StreamPlan
+from poisson_ellipse_tpu.utils.timing import fence
+
+def t_run(f, args, reps=4):
+    out = f(*args); fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = f(*args); fence(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+def per_solve(solver, args, n=4):
+    def chained(k):
+        def g(*ops):
+            r0 = ops[-1]
+            def one(i, acc):
+                res = solver(*ops[:-1], r0 * (1.0 + 1e-12 * acc))
+                return acc + res.diff
+            return lax.fori_loop(0, k, one, jnp.float32(0.0))
+        return jax.jit(g)
+    t1, _ = t_run(chained(1), args)
+    tn, _ = t_run(chained(n), args)
+    return (tn - t1) / (n - 1)
+
+for (M, N, oracle, xla_t) in [(1600,2400,1858,0.2833),(2400,3200,2449,1.1386)]:
+    prob = Problem(M=M, N=N)
+    plan = StreamPlan(prob, jnp.float32)
+    solver, args = build_streamed_solver(prob, jnp.float32)
+    _, out = t_run(solver, args, reps=1)
+    it = int(out.iters)
+    t = per_solve(solver, args)
+    print(f"{M}x{N}: streamed {t:.4f}s ({t/oracle*1e6:.1f} us/it) iters={it} "
+          f"(oracle {oracle}) conv={bool(out.converged)} resident={plan.resident} "
+          f"| vs XLA {xla_t}s: {xla_t/t:.2f}x")
