@@ -22,6 +22,7 @@ from poisson_ellipse_tpu.harness.run import (
     run_once,
 )
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.solver.engine import ENGINES
 
 
 def _parse_grids(args) -> list[tuple[int, int]]:
@@ -50,6 +51,14 @@ def main(argv=None) -> int:
         "--mode",
         choices=("auto", "single", "sharded", "native"),
         default="auto",
+    )
+    ap.add_argument(
+        "--engine",
+        choices=ENGINES,
+        default="auto",
+        help="single-device solver engine: auto picks the fastest that "
+        "fits (resident -> streamed -> xla); fused is the two-kernel "
+        "HBM iteration",
     )
     ap.add_argument(
         "--threads",
@@ -129,11 +138,21 @@ def main(argv=None) -> int:
                         mode=args.mode,
                         mesh_shape=tuple(args.mesh) if args.mesh else None,
                         dtype=args.dtype,
+                        engine=args.engine,
                         repeat=args.repeat,
                         batch=args.batch,
                         threads=args.threads,
                     )
             except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            except RuntimeError as e:
+                # the native runtime raises RuntimeError when g++ is
+                # missing or its build fails — an environment problem to
+                # report, not a traceback. JAX failures (XlaRuntimeError
+                # is a RuntimeError subclass) stay loud.
+                if args.mode != "native":
+                    raise
                 print(f"error: {e}", file=sys.stderr)
                 return 2
             phases = None

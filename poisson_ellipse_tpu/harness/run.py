@@ -17,12 +17,12 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from poisson_ellipse_tpu.models.problem import Problem
-from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
 from poisson_ellipse_tpu.parallel.pcg_sharded import build_sharded_solver
-from poisson_ellipse_tpu.solver.pcg import pcg
+from poisson_ellipse_tpu.solver.engine import build_solver
 from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
 from poisson_ellipse_tpu.utils.timing import PhaseTimer, fence
 
@@ -71,6 +71,7 @@ class RunReport:
     problem: Problem
     mesh_shape: tuple[int, int]
     dtype: str
+    engine: str
     iters: int
     converged: bool
     breakdown: bool
@@ -86,7 +87,7 @@ class RunReport:
             f"Grid: {p.M} x {p.N}  (h1={p.h1:.6g}, h2={p.h2:.6g}, "
             f"eps={p.eps_value:.6g}, delta={p.delta:g}, norm={p.norm})",
             f"Mesh: {self.mesh_shape[0]} x {self.mesh_shape[1]}  "
-            f"dtype={self.dtype}",
+            f"dtype={self.dtype}  engine={self.engine}",
             (
                 f"Converged after {self.iters} iterations (diff={self.diff:.3e})"
                 if self.converged
@@ -117,6 +118,7 @@ class RunReport:
             "N": p.N,
             "mesh": list(self.mesh_shape),
             "dtype": self.dtype,
+            "engine": self.engine,
             "eps": p.eps_value,
             "delta": p.delta,
             "iters": self.iters,
@@ -133,6 +135,7 @@ def run_once(
     mode: str = "auto",
     mesh_shape: tuple[int, int] | None = None,
     dtype: str = "f32",
+    engine: str = "auto",
     repeat: int = 1,
     batch: int = 1,
     threads: int = 0,
@@ -146,6 +149,8 @@ def run_once(
                       T_solver includes assembly, exactly as the
                       reference's stage0 chrono wraps its whole solve());
            "auto" — sharded iff >1 device or an explicit mesh is requested.
+    engine: single-device solver engine (``solver.engine.ENGINES``) —
+           "auto" picks the fastest that fits (resident → streamed → xla).
     repeat/batch: timing protocol — ``repeat`` measurements of ``batch``
     back-to-back dispatches each (batch>1 amortises host↔device RTT on
     tunneled backends); T_solver is the median over measurements.
@@ -163,17 +168,21 @@ def run_once(
     timer = PhaseTimer()
     if mode == "single":
         with timer.phase("init"):
-            a, b, rhs = assembly.assemble(problem, jdtype)
-            solver = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))
-            args = (a, b, rhs)
+            solver, args, engine = build_solver(problem, engine, jdtype)
             fence(args)
         shape = (1, 1)
     elif mode == "sharded":
+        if engine not in ("auto", "xla"):
+            raise ValueError(
+                f"engine {engine!r} is single-device only; the sharded "
+                "mode runs the XLA block stencil (engine 'xla')"
+            )
         with timer.phase("init"):
             mesh = resolve_mesh(mesh_shape)
             solver, args = build_sharded_solver(problem, mesh, jdtype)
             fence(args)
         shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
+        engine = "xla"
     else:
         raise ValueError(f"unknown mode: {mode!r}")
 
@@ -182,13 +191,37 @@ def run_once(
     result = solver(*args)
     fence(result)
 
-    times = []
-    for _ in range(repeat):
-        t0 = time.perf_counter()
-        for _ in range(batch):
+    if batch > 1 and mode == "single":
+        # Chained differential protocol: one jitted dispatch runs `batch`
+        # data-dependent solves (an opaque but value-exact perturbation of
+        # the RHS defeats CSE without changing any f.p. value); T_solver is
+        # the marginal cost (t_batch - t_single)/(batch - 1). This isolates
+        # the solve from the fixed per-dispatch host<->device RTT — the
+        # reference's MPI_Wtime brackets a locally attached GPU and pays no
+        # such tunnel cost (poisson_mpi_cuda2.cu:1009-1015).
+        chained = _chain_solver(solver, args, batch)
+        out = chained(*args)
+        fence(out)
+        t1s, tbs = [], []
+        for _ in range(repeat):
+            t0 = time.perf_counter()
             result = solver(*args)
-        fence(result)
-        times.append((time.perf_counter() - t0) / batch)
+            fence(result)
+            t1s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            out = chained(*args)
+            fence(out)
+            tbs.append(time.perf_counter() - t0)
+        t1 = statistics.median(t1s)
+        times = [max(tb - t1, 0.0) / (batch - 1) for tb in tbs]
+    else:
+        times = []
+        for _ in range(repeat):
+            t0 = time.perf_counter()
+            for _ in range(batch):
+                result = solver(*args)
+            fence(result)
+            times.append((time.perf_counter() - t0) / batch)
     timer.add("solver", statistics.median(times))
 
     with timer.phase("finalize"):
@@ -198,6 +231,7 @@ def run_once(
         problem=problem,
         mesh_shape=shape,
         dtype=dtype,
+        engine=engine,
         iters=int(result.iters),
         converged=bool(result.converged),
         breakdown=bool(result.breakdown),
@@ -207,6 +241,32 @@ def run_once(
         t_solver=timer.totals["solver"],
         times=times,
     )
+
+
+def _chain_solver(solver, args, n: int):
+    """One jitted dispatch running n data-dependent solves.
+
+    Relies on the ``build_solver`` contract that the last arg is the RHS.
+    The RHS of solve k+1 is multiplied by (1 + tiny*acc_k) where tiny is
+    far below the dtype's machine epsilon relative to any reachable acc,
+    so the product is bit-identical to the RHS (iteration counts and
+    solutions are unchanged — verified against the published oracles) while
+    the data dependence stops XLA deduplicating the solves.
+    """
+    rhs = args[-1]
+    tiny = 1e-30 if jnp.dtype(rhs.dtype).itemsize >= 8 else 1e-12
+
+    def chained(*a):
+        r0 = a[-1]
+
+        def one(_i, acc):
+            res = solver(*a[:-1], r0 * (1.0 + tiny * acc))
+            return acc + res.diff.astype(acc.dtype)
+
+        acc = lax.fori_loop(0, n - 1, one, jnp.zeros((), r0.dtype))
+        return solver(*a[:-1], r0 * (1.0 + tiny * acc))
+
+    return jax.jit(chained)
 
 
 def _run_native(problem: Problem, repeat: int, threads: int) -> RunReport:
@@ -225,6 +285,7 @@ def _run_native(problem: Problem, repeat: int, threads: int) -> RunReport:
         problem=problem,
         mesh_shape=(1, 1),
         dtype="f64",
+        engine="native",
         iters=result.iters,
         converged=result.converged,
         breakdown=result.breakdown,

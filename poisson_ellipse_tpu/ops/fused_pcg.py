@@ -292,12 +292,30 @@ def normalized_coefficients(problem: Problem, a, b, g1p: int, g2p: int,
     keeps the iteration-count oracles exact. Jax-array (traced) inputs
     are supported too and computed in their own dtype.
     """
+    if dtype is None:
+        dtype = a.dtype
+    pieces = interior_normalized(problem, a, b)
     import numpy as np
 
     xp = np if isinstance(a, np.ndarray) else jnp
     g1, g2 = a.shape
-    if dtype is None:
-        dtype = a.dtype
+    pad = ((0, g1p - g1), (0, g2p - g2))
+    return tuple(
+        jnp.asarray(xp.pad(x, pad).astype(dtype)) for x in pieces
+    )
+
+
+def interior_normalized(problem: Problem, a, b):
+    """(an, as_, bw, be, d, dinv) in the *input* precision, unpadded.
+
+    The single source of the normalised/guarded operand algebra — the
+    streamed engine reuses the ``dinv`` element so the two "value
+    identical" paths cannot drift (they share the code, not a copy).
+    """
+    import numpy as np
+
+    xp = np if isinstance(a, np.ndarray) else jnp
+    g1, g2 = a.shape
     ih1 = 1.0 / (float(problem.h1) * float(problem.h1))
     ih2 = 1.0 / (float(problem.h2) * float(problem.h2))
     an = a * ih1
@@ -315,11 +333,7 @@ def normalized_coefficients(problem: Problem, a, b, g1p: int, g2p: int,
     )
     d = an + as_ + bw + be
     dinv = xp.where(d != 0.0, 1.0 / xp.where(d != 0.0, d, 1.0), z)
-    pad = ((0, g1p - g1), (0, g2p - g2))
-    return tuple(
-        jnp.asarray(xp.pad(x, pad).astype(dtype))
-        for x in (an, as_, bw, be, d, dinv)
-    )
+    return an, as_, bw, be, d, dinv
 
 
 def fused_operands(problem: Problem, g1p: int, g2p: int, dtype):

@@ -77,19 +77,31 @@ class StreamPlan:
         self.g1p = _round_up(g1, self.tm)
         self.n_tiles = self.g1p // self.tm
         item = jnp.dtype(dtype).itemsize
-        arr = self.g1p * self.g2p * item
+        row = self.g2p * item
         budget = _VMEM_USABLE
         # state is always resident: w, r + p with its zero bands
-        budget -= 3 * arr + 2 * _BAND * self.g2p * item
-        # greedy residency, highest streamed-passes-saved first:
-        # dinv is read twice per iteration, ap written+read once each
+        budget -= (3 * self.g1p + 2 * _BAND) * row
+        # per-operand buffer rows: streamed operands get a tile-sized
+        # buffer (matching the kernel's scratch_shapes exactly), resident
+        # ones hold the full padded array
+        tile_rows = {"dinv": self.tm, "ap": self.tm,
+                     "a": self.tm + 8, "b": self.tm}
+        full_rows = {"dinv": self.g1p, "ap": self.g1p,
+                     "a": self.g1p + 8, "b": self.g1p}
+        # the gate: state + the minimum (all-streamed) buffer set must fit
+        self.min_stream_bytes = sum(tile_rows.values()) * row
+        self.fits = budget >= self.min_stream_bytes
+        # greedy residency, highest streamed-passes-saved first (dinv is
+        # read twice per iteration, ap written+read once each); upgrading
+        # an operand to resident swaps its tile buffer for the full array
+        budget -= self.min_stream_bytes
         self.resident = {}
-        for name, cost in (("dinv", arr), ("ap", arr),
-                           ("a", arr + 8 * self.g2p * item), ("b", arr)):
-            take = cost + 16 * self.g2p * item <= budget
+        for name in ("dinv", "ap", "a", "b"):
+            extra = (full_rows[name] - tile_rows[name]) * row
+            take = self.fits and extra <= budget
             self.resident[name] = take
             if take:
-                budget -= cost
+                budget -= extra
 
     def streamed_passes_per_iter(self) -> float:
         """HBM array-passes per iteration (for the roofline report)."""
@@ -103,6 +115,17 @@ class StreamPlan:
         if not self.resident["b"]:
             p += 1.0
         return p
+
+
+def fits_streamed(problem: Problem, dtype=jnp.float32) -> bool:
+    """True if the always-resident PCG state (w, r, banded p) plus the
+    minimum double-buffered stream buffers fit the VMEM budget.
+
+    The state itself cannot be streamed (it is read and written every
+    pass of every iteration), so grids past this gate — e.g. the 4097²
+    node grid, whose state alone is ~201 MB — need the sharded path.
+    """
+    return StreamPlan(problem, dtype).fits
 
 
 def _shift_cols_right(x):
@@ -329,6 +352,12 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
         interpret = _interpret_default()
     g1, g2 = problem.node_shape
     plan = StreamPlan(problem, dtype)
+    if not plan.fits:
+        raise ValueError(
+            f"grid {problem.M}x{problem.N}: PCG state (w, r, p) alone "
+            "exceeds the VMEM budget — the streamed engine cannot hold "
+            "it on-chip; use the XLA path or the sharded solver"
+        )
     g1p, g2p, tm = plan.g1p, plan.g2p, plan.tm
     np_dtype = np.dtype(jnp.dtype(dtype).name)
 
@@ -341,20 +370,10 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
             ).astype(np_dtype)
         )
 
-    # guarded 1/D from the f64 diagonal (an + as + bw + be)
-    ih1 = 1.0 / (problem.h1 * problem.h1)
-    ih2 = 1.0 / (problem.h2 * problem.h2)
-    an = a64 * ih1
-    as_ = np.roll(an, -1, axis=0)
-    bw = b64 * ih2
-    be = np.roll(bw, -1, axis=1)
-    gi = np.arange(g1)[:, None]
-    gj = np.arange(g2)[None, :]
-    interior = (
-        (gi >= 1) & (gi <= problem.M - 1) & (gj >= 1) & (gj <= problem.N - 1)
-    )
-    d64 = np.where(interior, an + as_ + bw + be, 0.0)
-    dinv64 = np.where(d64 != 0.0, 1.0 / np.where(d64 != 0.0, d64, 1.0), 0.0)
+    # guarded 1/D from the f64 diagonal — shared with the fused engine
+    from poisson_ellipse_tpu.ops.fused_pcg import interior_normalized
+
+    dinv64 = interior_normalized(problem, a64, b64)[5]
 
     args = (padded(dinv64), padded(a64, 8), padded(b64), padded(rhs64))
 

@@ -6,6 +6,11 @@ from poisson_ellipse_tpu.solver.checkpoint import (
     CheckpointingSolver,
     solve_with_checkpoints,
 )
+from poisson_ellipse_tpu.solver.engine import (
+    ENGINES,
+    build_solver,
+    select_engine,
+)
 from poisson_ellipse_tpu.solver.pcg import (
     PCGResult,
     advance,
@@ -17,11 +22,14 @@ from poisson_ellipse_tpu.solver.pcg import (
 
 __all__ = [
     "CheckpointingSolver",
+    "ENGINES",
     "PCGResult",
     "advance",
+    "build_solver",
     "init_state",
     "pcg",
     "result_of",
+    "select_engine",
     "solve",
     "solve_with_checkpoints",
 ]

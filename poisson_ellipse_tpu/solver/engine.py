@@ -1,0 +1,91 @@
+"""Engine selection: one entry point over the four single-chip solvers.
+
+The reference's ``main`` always runs its fastest implementation — stage4
+launches every CUDA kernel each iteration (``poisson_mpi_cuda2.cu:985-1038``,
+``:846-939``). The TPU framework has four single-chip engines with different
+capacity/perf envelopes; this module is the policy that picks the fastest
+one that fits, so every product entry point (bench, CLI, harness) gets the
+best path by default:
+
+  engine       capacity (f32)                measured vs XLA (bench chip)
+  ---------    ---------------------------   ----------------------------
+  resident     whole solve in VMEM           2.9-5.9x  (<= ~900x1300)
+  streamed     state in VMEM, ops streamed   ~1.9x     (<= ~2400x3200)
+  fused        two-kernel HBM iteration      ~1.2x     (small-mid grids)
+  xla          lax.while_loop, XLA-fused     1.0x      (any grid, any dtype)
+
+Policy (``select_engine``): resident if the whole working set fits VMEM;
+else streamed if the state fits; else xla. f64 always takes xla — the
+Pallas engines are f32/bf16 (TPU f64 is emulated, and the XLA path is the
+only one with an f64 story). ``fused`` never wins outright on the bench
+chip so auto never picks it, but it remains selectable for comparison.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
+
+# the Pallas engine modules import solver.pcg at their top level (which
+# runs this package's __init__), so they are imported lazily here
+
+ENGINES = ("auto", "xla", "fused", "resident", "streamed")
+
+
+def select_engine(problem: Problem, dtype=jnp.float32) -> str:
+    """The concrete engine "auto" resolves to for this problem/dtype."""
+    from poisson_ellipse_tpu.ops.resident_pcg import fits_resident
+    from poisson_ellipse_tpu.ops.streamed_pcg import fits_streamed
+
+    if jnp.dtype(dtype).itemsize >= 8:
+        return "xla"
+    if fits_resident(problem, dtype):
+        return "resident"
+    if fits_streamed(problem, dtype):
+        return "streamed"
+    return "xla"
+
+
+def build_solver(
+    problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None
+):
+    """(jitted solver, args, resolved_engine) for a single-chip solve.
+
+    All engines share the PCGResult contract and the f64-host-assembled,
+    rounded-once operand fidelity, so swapping engines changes speed, not
+    iteration counts (verified against the published oracles).
+    """
+    if engine == "auto":
+        engine = select_engine(problem, dtype)
+    if engine == "resident":
+        from poisson_ellipse_tpu.ops.resident_pcg import build_resident_solver
+
+        solver, args = build_resident_solver(problem, dtype, interpret=interpret)
+    elif engine == "streamed":
+        from poisson_ellipse_tpu.ops.streamed_pcg import build_streamed_solver
+
+        solver, args = build_streamed_solver(problem, dtype, interpret=interpret)
+    elif engine == "fused":
+        from poisson_ellipse_tpu.ops.fused_pcg import build_fused_solver
+
+        solver, args = build_fused_solver(problem, dtype, interpret=interpret)
+    elif engine == "xla":
+        import jax
+
+        a, b, rhs = assembly.assemble(problem, dtype)
+        solver = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))
+        args = (a, b, rhs)
+    else:
+        raise ValueError(f"unknown engine: {engine!r} (choose from {ENGINES})")
+    return solver, args, engine
+
+
+def solve(
+    problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None
+) -> PCGResult:
+    """Assemble and solve single-chip with the selected engine."""
+    solver, args, _ = build_solver(problem, engine, dtype, interpret=interpret)
+    return solver(*args)

@@ -1,0 +1,198 @@
+"""The single-chip engine suite: fused / resident / streamed vs the XLA path.
+
+The reference's cross-implementation correctness oracle is iteration-count
+invariance across its five implementations (SURVEY §4.2: the same grid
+converges in the same number of PCG iterations in every stage). The four
+TPU engines are held to the same standard — identical iteration counts and
+matching solutions on the oracle grids — plus capacity-gate and selection-
+policy checks. Pallas kernels run in interpret mode on the CPU backend
+(the engines' own ``_interpret_default``), so this suite needs no TPU.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.harness.run import _chain_solver, run_once
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.fused_pcg import interior_normalized, solve_fused
+from poisson_ellipse_tpu.ops.resident_pcg import fits_resident, solve_resident
+from poisson_ellipse_tpu.ops.streamed_pcg import (
+    StreamPlan,
+    build_streamed_solver,
+    fits_streamed,
+    solve_streamed,
+)
+from poisson_ellipse_tpu.solver.engine import build_solver, select_engine, solve
+from poisson_ellipse_tpu.solver.pcg import solve as solve_xla
+
+ENGINES = {
+    "fused": solve_fused,
+    "resident": solve_resident,
+    "streamed": solve_streamed,
+}
+
+# committed reference code oracles (see tests/test_pcg.py for provenance)
+UNWEIGHTED_ORACLE = {(10, 10): 17, (20, 20): 31, (40, 40): 61}
+WEIGHTED_ORACLE = {(10, 10): 15, (20, 20): 26, (40, 40): 50}
+
+
+@pytest.mark.parametrize("engine", sorted(ENGINES))
+@pytest.mark.parametrize("M,N", sorted(UNWEIGHTED_ORACLE))
+def test_parity_unweighted(engine, M, N):
+    problem = Problem(M=M, N=N, norm="unweighted")
+    ref = solve_xla(problem, jnp.float32)
+    got = ENGINES[engine](problem, jnp.float32)
+    assert int(got.iters) == int(ref.iters) == UNWEIGHTED_ORACLE[(M, N)]
+    assert bool(got.converged)
+    assert not bool(got.breakdown)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), atol=5e-6
+    )
+
+
+@pytest.mark.parametrize("engine", sorted(ENGINES))
+@pytest.mark.parametrize("M,N", [(20, 20), (40, 40)])
+def test_parity_weighted(engine, M, N):
+    problem = Problem(M=M, N=N, norm="weighted")
+    ref = solve_xla(problem, jnp.float32)
+    got = ENGINES[engine](problem, jnp.float32)
+    assert int(got.iters) == int(ref.iters) == WEIGHTED_ORACLE[(M, N)]
+    assert bool(got.converged)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), atol=5e-6
+    )
+
+
+@pytest.mark.parametrize("engine", sorted(ENGINES))
+def test_parity_non_aligned_multi_tile(engine):
+    """A shape that is neither row-tile- nor lane-aligned, spanning
+    multiple tiles in every engine's tiling."""
+    problem = Problem(M=44, N=132, norm="weighted")
+    ref = solve_xla(problem, jnp.float32)
+    got = ENGINES[engine](problem, jnp.float32)
+    assert int(got.iters) == int(ref.iters)
+    assert bool(got.converged)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), atol=5e-6
+    )
+
+
+@pytest.mark.parametrize("engine", sorted(ENGINES))
+def test_max_iter_cap(engine):
+    problem = Problem(M=40, N=40, max_iter=5)
+    got = ENGINES[engine](problem, jnp.float32)
+    assert int(got.iters) == 5
+    assert not bool(got.converged)
+    assert not bool(got.breakdown)
+
+
+@pytest.mark.parametrize("dtype", ["f64"])
+def test_engines_reject_f64(dtype):
+    problem = Problem(M=10, N=10)
+    for fn in ENGINES.values():
+        with pytest.raises(ValueError):
+            fn(problem, jnp.float64)
+
+
+# ---------------------------------------------------------------- capacity
+
+
+def test_fits_resident_small_and_large():
+    assert fits_resident(Problem(M=40, N=40))
+    assert fits_resident(Problem(M=800, N=1200))
+    assert not fits_resident(Problem(M=1600, N=2400))
+
+
+def test_fits_streamed_gate():
+    assert fits_streamed(Problem(M=1600, N=2400))
+    assert fits_streamed(Problem(M=2400, N=3200))
+    # north-star 4096²: state alone (~201 MB) exceeds VMEM
+    assert not fits_streamed(Problem(M=4096, N=4096))
+
+
+def test_streamed_build_rejects_oversize():
+    with pytest.raises(ValueError, match="VMEM"):
+        build_streamed_solver(Problem(M=4096, N=4096))
+
+
+def test_stream_plan_shapes():
+    plan = StreamPlan(Problem(M=1600, N=2400), jnp.float32)
+    assert plan.g1p % plan.tm == 0
+    assert plan.g2p % 128 == 0
+    assert plan.n_tiles == plan.g1p // plan.tm
+    assert plan.fits
+    # residency must be a subset of what the budget allows; the always-
+    # resident state is excluded from the dict
+    assert set(plan.resident) == {"dinv", "ap", "a", "b"}
+    assert plan.streamed_passes_per_iter() >= 0.0
+
+
+# ---------------------------------------------------------------- policy
+
+
+def test_select_engine_policy():
+    assert select_engine(Problem(M=40, N=40)) == "resident"
+    assert select_engine(Problem(M=800, N=1200)) == "resident"
+    assert select_engine(Problem(M=1600, N=2400)) == "streamed"
+    assert select_engine(Problem(M=4096, N=4096)) == "xla"
+    # f64 always takes the XLA path (Pallas engines are f32/bf16)
+    assert select_engine(Problem(M=40, N=40), jnp.float64) == "xla"
+
+
+def test_build_solver_resolves_auto_and_rejects_unknown():
+    solver, args, engine = build_solver(Problem(M=20, N=20), "auto")
+    assert engine == "resident"
+    result = solver(*args)
+    assert int(result.iters) == WEIGHTED_ORACLE[(20, 20)]
+    with pytest.raises(ValueError, match="unknown engine"):
+        build_solver(Problem(M=20, N=20), "cuda")
+
+
+def test_engine_solve_entry_point():
+    result = solve(Problem(M=20, N=20), engine="auto")
+    assert int(result.iters) == WEIGHTED_ORACLE[(20, 20)]
+    assert bool(result.converged)
+
+
+# ---------------------------------------------------------------- shared ops
+
+
+def test_interior_normalized_shared_dinv():
+    """The streamed engine's dinv must be the exact fused-engine value
+    (they share interior_normalized — this pins the contract)."""
+    problem = Problem(M=20, N=20)
+    from poisson_ellipse_tpu.ops import assembly
+
+    a64, b64, _ = assembly.assemble_numpy(problem)
+    an, as_, bw, be, d, dinv = interior_normalized(problem, a64, b64)
+    assert dinv.dtype == np.float64
+    inner = d[1:-1, 1:-1]
+    np.testing.assert_allclose(
+        dinv[1:-1, 1:-1][inner != 0], 1.0 / inner[inner != 0], rtol=0
+    )
+    # ring is exactly zero
+    assert (dinv[0] == 0).all() and (dinv[-1] == 0).all()
+
+
+# ---------------------------------------------------------------- protocol
+
+
+def test_chain_solver_value_exact():
+    """The chained differential timing protocol must not change values."""
+    problem = Problem(M=20, N=20)
+    solver, args, _ = build_solver(problem, "xla", jnp.float32)
+    ref = solver(*args)
+    chained = _chain_solver(solver, args, 3)
+    got = chained(*args)
+    assert int(got.iters) == int(ref.iters)
+    np.testing.assert_array_equal(np.asarray(got.w), np.asarray(ref.w))
+
+
+def test_run_once_engine_auto_reports_engine():
+    report = run_once(
+        Problem(M=20, N=20), mode="single", engine="auto", repeat=1, batch=2
+    )
+    assert report.engine == "resident"
+    assert report.iters == WEIGHTED_ORACLE[(20, 20)]
+    assert report.converged
