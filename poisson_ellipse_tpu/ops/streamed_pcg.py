@@ -11,13 +11,16 @@ capping it at ~1000x1500. This kernel removes that cap two ways:
   VMEM scratch across the whole ``lax.while_loop`` (the entire point —
   state never touches HBM); each loop-invariant operand (Dinv, a, b) and
   the ap intermediate is either VMEM-resident too (loaded once) or
-  streamed per tile from HBM with ``make_async_copy`` double-buffering,
-  chosen greedily to fill the measured ~127 MB of VMEM.
+  streamed per tile from HBM into a 2-slot buffer, software-pipelined
+  (the DMA for tile t+1 overlaps tile t's compute; ap stores lag two
+  tiles), chosen greedily to fill the measured ~127 MB of VMEM.
 
-On the bench chip this makes 1600x2400 all-resident (zero HBM bytes per
-iteration) and 2400x3200 stream only Dinv and ap (~6 array-passes/iter
-vs the ~13 the XLA while_loop streams once the working set outgrows
-VMEM) — the two reference grids where the XLA path is HBM-bound.
+Measured residency on the bench chip (``StreamPlan(...).resident``):
+1600x2400 is **all-resident** — zero HBM bytes per iteration — while at
+2400x3200 the state alone takes ~97 MB of the ~114 MB budget, so **all
+four operands stream** (~6 array-passes/iter vs the ~13 the XLA
+while_loop streams once the working set outgrows VMEM) behind the
+double-buffered pipeline.
 
 Per iteration, three tile sweeps inside one kernel:
 
@@ -81,13 +84,15 @@ class StreamPlan:
         budget = _VMEM_USABLE
         # state is always resident: w, r + p with its zero bands
         budget -= (3 * self.g1p + 2 * _BAND) * row
-        # per-operand buffer rows: streamed operands get a tile-sized
-        # buffer (matching the kernel's scratch_shapes exactly), resident
-        # ones hold the full padded array
-        tile_rows = {"dinv": self.tm, "ap": self.tm,
-                     "a": self.tm + 8, "b": self.tm}
-        full_rows = {"dinv": self.g1p, "ap": self.g1p,
-                     "a": self.g1p + 8, "b": self.g1p}
+        # per-operand buffer rows: streamed operands get a double-buffered
+        # 2-slot tile buffer (the single source of the scratch_shapes row
+        # counts), resident ones hold the full padded array ("a" carries
+        # an 8-row halo in both forms)
+        self.tile_rows = {"dinv": 2 * self.tm, "ap": 2 * self.tm,
+                          "a": 2 * (self.tm + 8), "b": 2 * self.tm}
+        self.full_rows = {"dinv": self.g1p, "ap": self.g1p,
+                          "a": self.g1p + 8, "b": self.g1p}
+        tile_rows, full_rows = self.tile_rows, self.full_rows
         # the gate: state + the minimum (all-streamed) buffer set must fit
         self.min_stream_bytes = sum(tile_rows.values()) * row
         self.fits = budget >= self.min_stream_bytes
@@ -138,6 +143,9 @@ def _shift_cols_left(x):
     return jnp.concatenate([x[:, 1:], zero], axis=1)
 
 
+_NSLOT = 2  # double buffering: prefetch tile t+1 while computing tile t
+
+
 def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
                  # HBM / maybe-VMEM inputs
                  dinv_hbm, a_hbm, b_hbm, r0_hbm,
@@ -155,63 +163,74 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
     M, N = problem.M, problem.N
     res = plan.resident
 
-    # -- residency helpers -------------------------------------------------
-    # serial copies: start+wait around each tile (the streamed arrays are
-    # a small fraction of iteration time; see module docstring)
+    # -- streamed-operand machinery ---------------------------------------
+    # Each streamed operand owns a 2-slot buffer and 2 semaphores; loads
+    # are software-pipelined (start t+1, wait t, compute t) so the DMA for
+    # the next tile overlaps the current tile's compute. Resident operands
+    # hold the full array and read directly.
+    _SEM = {"dinv": 0, "a": 2, "b": 4, "ap": 6}
+    # slot stride (rows per slot) derived from the plan's 2-slot buffers
+    _ALLOC = {k: v // _NSLOT for k, v in plan.tile_rows.items()}
+    _BUF = {"dinv": dinv_buf, "a": a_buf, "b": b_buf, "ap": ap_buf}
+    _HBM = {"dinv": dinv_hbm, "a": a_hbm, "b": b_hbm, "ap": ap_hbm}
 
-    def load(hbm, buf, sem, t, rows):
-        cp = pltpu.make_async_copy(
-            hbm.at[pl.ds(t * tm, rows), :], buf.at[pl.ds(0, rows), :], sem
+    def _load_copy(name, t, slot):
+        rows = _ALLOC[name]
+        return pltpu.make_async_copy(
+            _HBM[name].at[pl.ds(t * tm, rows), :],
+            _BUF[name].at[pl.ds(slot * rows, rows), :],
+            sems.at[_SEM[name] + slot],
         )
-        cp.start()
-        cp.wait()
-        return buf
 
-    def dinv_tile(t):
-        if res["dinv"]:
-            return dinv_buf[pl.ds(t * tm, tm), :]
-        return load(dinv_hbm, dinv_buf, sems.at[0], t, tm)[0:tm, :]
-
-    def a_win(t):
-        """Rows t0 .. t0+tm (tm+1 rows; buffer is tm+8-aligned)."""
-        if res["a"]:
-            return a_buf[pl.ds(t * tm, tm + 1), :]
-        return load(a_hbm, a_buf, sems.at[1], t, tm + 8)[0 : tm + 1, :]
-
-    def b_tile(t):
-        if res["b"]:
-            return b_buf[pl.ds(t * tm, tm), :]
-        return load(b_hbm, b_buf, sems.at[2], t, tm)[0:tm, :]
-
-    def ap_store(t, val):
-        if res["ap"]:
-            ap_buf[pl.ds(t * tm, tm), :] = val
-        else:
-            ap_buf[...] = val
-            cp = pltpu.make_async_copy(
-                ap_buf, ap_hbm.at[pl.ds(t * tm, tm), :], sems.at[3]
-            )
-            cp.start()
-            cp.wait()
-
-    def ap_load(t):
-        if res["ap"]:
-            return ap_buf[pl.ds(t * tm, tm), :]
-        cp = pltpu.make_async_copy(
-            ap_hbm.at[pl.ds(t * tm, tm), :], ap_buf, sems.at[3]
+    def _loader(name):
+        """(start, wait) pair for the pipelined loop; None if resident."""
+        if res[name]:
+            return None
+        return (
+            lambda t, slot: _load_copy(name, t, slot).start(),
+            lambda t, slot: _load_copy(name, t, slot).wait(),
         )
-        cp.start()
-        cp.wait()
-        return ap_buf[...]
+
+    def _read(name, t, slot, rows):
+        """Tile rows of a (possibly resident) operand after its wait."""
+        if res[name]:
+            return _BUF[name][pl.ds(t * tm, rows), :]
+        return _BUF[name][pl.ds(slot * _ALLOC[name], rows), :]
+
+    def _pipelined(loaders, compute, carry0):
+        """fori_loop over tiles with all streamed loads double-buffered."""
+        loaders = [ld for ld in loaders if ld is not None]
+        for start, _ in loaders:
+            start(0, 0)
+
+        def body(t, carry):
+            slot = lax.rem(t, _NSLOT)
+
+            @pl.when(t + 1 < n_tiles)
+            def _():
+                nxt = lax.rem(t + 1, _NSLOT)
+                for start, _ in loaders:
+                    start(t + 1, nxt)
+
+            for _, wait in loaders:
+                wait(t, slot)
+            return compute(t, slot, carry)
+
+        return lax.fori_loop(0, n_tiles, body, carry0)
+
+    def _ap_store_copy(t, slot):
+        return pltpu.make_async_copy(
+            ap_buf.at[pl.ds(slot * tm, tm), :],
+            ap_hbm.at[pl.ds(t * tm, tm), :],
+            sems.at[_SEM["ap"] + slot],
+        )
 
     # -- one-time initialisation ------------------------------------------
-    for name, hbm, buf, rows in (
-        ("dinv", dinv_hbm, dinv_buf, plan.g1p),
-        ("a", a_hbm, a_buf, plan.g1p + 8),
-        ("b", b_hbm, b_buf, plan.g1p),
-    ):
+    for name in ("dinv", "a", "b"):
         if res[name]:
-            cp = pltpu.make_async_copy(hbm, buf, sems.at[0])
+            cp = pltpu.make_async_copy(
+                _HBM[name], _BUF[name], sems.at[_SEM[name]]
+            )
             cp.start()
             cp.wait()
 
@@ -221,20 +240,16 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
     cp.start()
     cp.wait()
 
-    def tile_sum(fold):
-        def body(t, acc):
-            return acc + fold(t)
-        return lax.fori_loop(0, n_tiles, body, jnp.zeros((), dtype))
+    def _zr0_tile(t, slot, acc):
+        rt = r_s[pl.ds(t * tm, tm), :]
+        return acc + jnp.sum((rt * _read("dinv", t, slot, tm)) * rt)
 
-    zr0 = tile_sum(
-        lambda t: jnp.sum(
-            (r_s[pl.ds(t * tm, tm), :] * dinv_tile(t))
-            * r_s[pl.ds(t * tm, tm), :]
-        )
+    zr0 = _pipelined(
+        [_loader("dinv")], _zr0_tile, jnp.zeros((), dtype)
     ) * h1h2
 
     # -- the stencil for one tile -----------------------------------------
-    def stencil_tile(t):
+    def stencil_tile(t, slot):
         """A(p) on tile t, reference FP form, ring/padding masked.
 
         Row neighbours come from aligned 8-row block loads + value-level
@@ -246,10 +261,10 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
         p_below = p_s[pl.ds(_BAND + (t + 1) * tm, 8), :]
         pu = jnp.concatenate([p_above[7:8, :], pc[:-1]], axis=0)
         pd = jnp.concatenate([pc[1:], p_below[0:1, :]], axis=0)
-        aw = a_win(t)
+        aw = _read("a", t, slot, tm + 1)
         ac = aw[0:tm, :]
         ad = aw[1 : tm + 1, :]
-        bc = b_tile(t)
+        bc = _read("b", t, slot, tm)
         br = _shift_cols_left(bc)
         pl_ = _shift_cols_right(pc)
         pr = _shift_cols_left(pc)
@@ -277,44 +292,58 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
         k, zr, beta, diff, _cv, _bd = c
 
         # pass A: p <- r*Dinv + beta*p
-        def pass_a(t, _):
+        def pass_a(t, slot, acc):
             rows = pl.ds(_BAND + t * tm, tm)
             p_s[rows, :] = (
-                r_s[pl.ds(t * tm, tm), :] * dinv_tile(t)
+                r_s[pl.ds(t * tm, tm), :] * _read("dinv", t, slot, tm)
                 + beta * p_s[rows, :]
             )
-            return 0
-        lax.fori_loop(0, n_tiles, pass_a, 0)
+            return acc
+        _pipelined([_loader("dinv")], pass_a, 0)
 
-        # pass B: ap = A(p), denom
-        def pass_b(t, acc):
-            apt, pc = stencil_tile(t)
-            ap_store(t, apt)
+        # pass B: ap = A(p), denom. Streamed ap stores lag two tiles
+        # behind (same slot), so a slot is only rewritten after its
+        # previous store has drained.
+        def pass_b(t, slot, acc):
+            apt, pc = stencil_tile(t, slot)
+            if res["ap"]:
+                ap_buf[pl.ds(t * tm, tm), :] = apt
+            else:
+                @pl.when(t >= _NSLOT)
+                def _():
+                    _ap_store_copy(t - _NSLOT, slot).wait()
+
+                ap_buf[pl.ds(slot * tm, tm), :] = apt
+                _ap_store_copy(t, slot).start()
             return acc + jnp.sum(apt * pc)
-        denom = lax.fori_loop(
-            0, n_tiles, pass_b, jnp.zeros((), dtype)
+        denom = _pipelined(
+            [_loader("a"), _loader("b")], pass_b, jnp.zeros((), dtype)
         ) * h1h2
+        if not res["ap"]:
+            # drain the trailing stores (n_tiles is static: unrolls)
+            for t_tail in range(max(n_tiles - _NSLOT, 0), n_tiles):
+                _ap_store_copy(t_tail, t_tail % _NSLOT).wait()
 
         breakdown = denom < DENOM_GUARD
         alpha = zr / jnp.where(breakdown, jnp.ones_like(denom), denom)
         alpha = jnp.where(breakdown, jnp.zeros_like(alpha), alpha)
 
         # pass C: fused updates + both reductions
-        def pass_c(t, acc):
+        def pass_c(t, slot, acc):
             dw2a, zra = acc
             rows = pl.ds(t * tm, tm)
             w = w_s[rows, :]
             w_new = w + alpha * p_s[pl.ds(_BAND + t * tm, tm), :]
             dw = w_new - w
             w_s[rows, :] = w_new
-            r_new = r_s[rows, :] - alpha * ap_load(t)
+            r_new = r_s[rows, :] - alpha * _read("ap", t, slot, tm)
             r_s[rows, :] = r_new
             return (
                 dw2a + jnp.sum(dw * dw),
-                zra + jnp.sum((r_new * dinv_tile(t)) * r_new),
+                zra + jnp.sum((r_new * _read("dinv", t, slot, tm)) * r_new),
             )
-        dw2, zr_raw = lax.fori_loop(
-            0, n_tiles, pass_c,
+        dw2, zr_raw = _pipelined(
+            [_loader("ap"), _loader("dinv")], pass_c,
             (jnp.zeros((), dtype), jnp.zeros((), dtype)),
         )
         zr_new = zr_raw * h1h2
@@ -383,10 +412,11 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
     anyspec = lambda: pl.BlockSpec(memory_space=pl.ANY)
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     res = plan.resident
-    buf = lambda name, rows, extra=0: (
-        pltpu.VMEM((g1p + extra, g2p), dtype)
-        if res[name]
-        else pltpu.VMEM((rows + extra, g2p), dtype)
+    # resident operands hold the full padded array; streamed ones get a
+    # 2-slot double buffer — row counts come from the plan (one source)
+    buf = lambda name: pltpu.VMEM(
+        ((plan.full_rows if res[name] else plan.tile_rows)[name], g2p),
+        dtype,
     )
     call = pl.pallas_call(
         kernel,
@@ -407,12 +437,11 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
             pltpu.VMEM((g1p, g2p), dtype),             # w
             pltpu.VMEM((g1p, g2p), dtype),             # r
             pltpu.VMEM((g1p + 2 * _BAND, g2p), dtype),  # p with bands
-            buf("dinv", tm),
-            buf("a", tm, 8),
-            buf("b", tm),
-            (pltpu.VMEM((g1p, g2p), dtype)
-             if res["ap"] else pltpu.VMEM((tm, g2p), dtype)),
-            pltpu.SemaphoreType.DMA((4,)),
+            buf("dinv"),
+            buf("a"),
+            buf("b"),
+            buf("ap"),
+            pltpu.SemaphoreType.DMA((8,)),
         ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT

@@ -116,6 +116,30 @@ def test_streamed_build_rejects_oversize():
         build_streamed_solver(Problem(M=4096, N=4096))
 
 
+def test_streamed_forced_all_streaming_parity(monkeypatch):
+    """Force resident={all False} so the double-buffered DMA pipeline
+    (slot reads, ap store lag, tail drain) actually executes — every grid
+    small enough for tests otherwise resolves to an all-resident plan."""
+    import poisson_ellipse_tpu.ops.streamed_pcg as sp
+
+    problem = Problem(M=200, N=132, norm="weighted")
+    ref = solve_xla(problem, jnp.float32)
+    base_plan = StreamPlan(problem, jnp.float32)
+    state_bytes = (3 * base_plan.g1p + 16) * base_plan.g2p * 4
+    monkeypatch.setattr(
+        sp, "_VMEM_USABLE", state_bytes + base_plan.min_stream_bytes
+    )
+    plan = sp.StreamPlan(problem, jnp.float32)
+    assert plan.fits and not any(plan.resident.values())
+    assert plan.n_tiles >= 3  # exercises even/odd slots + tail drain
+    got = sp.solve_streamed(problem, jnp.float32)
+    assert int(got.iters) == int(ref.iters)
+    assert bool(got.converged)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), atol=5e-6
+    )
+
+
 def test_stream_plan_shapes():
     plan = StreamPlan(Problem(M=1600, N=2400), jnp.float32)
     assert plan.g1p % plan.tm == 0
