@@ -34,11 +34,13 @@ BATCH = 9
 
 
 def bench_grid(M: int, N: int, oracle: int):
-    # run_once provides the measurement protocol: warm-up outside the timed
-    # region, BATCH back-to-back dispatches per repetition (amortising the
-    # host↔device tunnel RTT that would swamp small grids), fenced sync,
-    # median over REPS. engine="auto" selects the fastest single-chip
-    # engine that fits (VMEM-resident mega-kernel -> streamed -> XLA).
+    # run_once provides the measurement protocol: warm-up outside the
+    # timed region, then the chained differential — each rep times one
+    # plain dispatch and one chained dispatch of BATCH data-dependent
+    # solves, reporting the median marginal cost (t_chain - t_1)/(BATCH-1)
+    # so the fixed host<->device tunnel RTT cancels. engine="auto" selects
+    # the fastest single-chip engine that fits (VMEM-resident mega-kernel
+    # -> streamed -> XLA).
     report = run_once(
         Problem(M=M, N=N),
         mode="single",

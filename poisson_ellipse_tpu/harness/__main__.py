@@ -22,6 +22,7 @@ from poisson_ellipse_tpu.harness.run import (
     run_once,
 )
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.runtime.native import NativeBuildError
 from poisson_ellipse_tpu.solver.engine import ENGINES
 
 
@@ -73,7 +74,13 @@ def main(argv=None) -> int:
         metavar=("PX", "PY"),
         help="device mesh shape (default: near-square over all devices)",
     )
-    ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument(
+        "--dtype",
+        choices=sorted(DTYPES),
+        default="f32",
+        help="f64 flips jax_enable_x64 for the whole process (a global "
+        "JAX switch: later runs in the same process stay x64-enabled)",
+    )
     ap.add_argument("--delta", type=float, default=1e-6)
     ap.add_argument("--eps", type=float, default=None)
     ap.add_argument(
@@ -143,16 +150,10 @@ def main(argv=None) -> int:
                         batch=args.batch,
                         threads=args.threads,
                     )
-            except ValueError as e:
-                print(f"error: {e}", file=sys.stderr)
-                return 2
-            except RuntimeError as e:
-                # the native runtime raises RuntimeError when g++ is
-                # missing or its build fails — an environment problem to
-                # report, not a traceback. JAX failures (XlaRuntimeError
-                # is a RuntimeError subclass) stay loud.
-                if args.mode != "native":
-                    raise
+            except (ValueError, NativeBuildError) as e:
+                # NativeBuildError = g++ missing or the C++ build failed —
+                # an environment problem to report, not a traceback. Other
+                # RuntimeErrors (incl. jax XlaRuntimeError) stay loud.
                 print(f"error: {e}", file=sys.stderr)
                 return 2
             phases = None

@@ -151,9 +151,14 @@ def run_once(
            "auto" — sharded iff >1 device or an explicit mesh is requested.
     engine: single-device solver engine (``solver.engine.ENGINES``) —
            "auto" picks the fastest that fits (resident → streamed → xla).
-    repeat/batch: timing protocol — ``repeat`` measurements of ``batch``
-    back-to-back dispatches each (batch>1 amortises host↔device RTT on
-    tunneled backends); T_solver is the median over measurements.
+    repeat/batch: timing protocol. For single mode with batch>1, each of
+    the ``repeat`` measurements times one plain dispatch and one chained
+    dispatch of ``batch`` data-dependent solves, and T_solver is the
+    median *marginal* solve cost (t_chained − t_single)/(batch − 1) —
+    the fixed per-dispatch host↔device RTT cancels out (see
+    ``_chain_solver``). Otherwise ``repeat`` measurements of ``batch``
+    back-to-back dispatches each; T_solver is the median per-dispatch
+    time.
     """
     if mode == "native":
         return _run_native(problem, repeat=repeat, threads=threads)

@@ -162,7 +162,7 @@ def apply_a_block_pallas(w_ext, a_ext, b_ext, h1, h2, interpret=None):
     out = pl.pallas_call(
         kernel,
         grid=(k // tm,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_specs=pl.BlockSpec(
             (tm, bn), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),

@@ -11,8 +11,11 @@ twice — operands in at entry, solution out at exit.
 
 This is the design point the chip's memory system rewards: the bench
 part has ~128 MB of VMEM (measured; ``vmem_limit_bytes`` raised
-accordingly), so every reference grid up to ~1000x1500 fits the full
-working set on-chip, where iteration cost is pure VPU arithmetic
+accordingly), so grids whose ~17-array working set fits the 100 MB
+residency budget — everything up to roughly 900x1300, which covers the
+reference's 400x600 and 800x1200 headline grids (``fits_resident`` is
+the exact gate) — run the whole solve on-chip, where iteration cost is
+pure VPU arithmetic
 (~2-8 us/iter) instead of the ~40-75 us/iter the kernel-per-op
 structure costs. Grids that don't fit fall back to the streaming fused
 path (``ops.fused_pcg``) — use ``fits_resident`` to pick.

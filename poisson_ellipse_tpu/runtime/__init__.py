@@ -6,6 +6,7 @@ serves as an independent host oracle for the JAX/TPU path.
 """
 
 from poisson_ellipse_tpu.runtime.native import (
+    NativeBuildError,
     NativeResult,
     assemble_native,
     native_available,
@@ -14,6 +15,7 @@ from poisson_ellipse_tpu.runtime.native import (
 )
 
 __all__ = [
+    "NativeBuildError",
     "NativeResult",
     "assemble_native",
     "native_available",

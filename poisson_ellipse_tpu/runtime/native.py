@@ -29,6 +29,11 @@ _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
+class NativeBuildError(RuntimeError):
+    """The C++ runtime could not be built or loaded (g++ missing, build
+    failure) — an environment problem, distinct from solver errors."""
+
+
 class NativeResult(NamedTuple):
     w: np.ndarray
     iters: int
@@ -45,11 +50,18 @@ def _build() -> Optional[str]:
     a half-written library (the in-module lock is process-local only).
     """
     tmp = f"{_LIB}.{os.getpid()}.tmp"
-    for flags in (["-fopenmp"], []):  # fall back to sequential-only
+    # attempt order: drop -march=native (not every g++/arch accepts it)
+    # and -fopenmp independently so losing one flag never costs the other
+    attempts = (
+        ["-march=native", "-fopenmp"],
+        ["-fopenmp"],
+        ["-march=native"],
+        [],
+    )
+    for flags in attempts:
         cmd = [
             "g++",
             "-O3",
-            "-march=native",
             "-std=c++17",
             "-shared",
             "-fPIC",
@@ -114,7 +126,7 @@ def build_error() -> Optional[str]:
 def num_threads() -> int:
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+        raise NativeBuildError(f"native runtime unavailable: {_build_error}")
     return lib.pe_num_threads()
 
 
@@ -123,7 +135,7 @@ def solve_native(problem: Problem, threads: int = 0) -> NativeResult:
     0 → OpenMP default."""
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+        raise NativeBuildError(f"native runtime unavailable: {_build_error}")
     w = np.zeros(problem.node_shape, np.float64)
     iters = ctypes.c_int(0)
     diff = ctypes.c_double(0.0)
@@ -159,7 +171,7 @@ def assemble_native(problem: Problem):
     """C++ assembly of (a, b, rhs) — golden cross-check for ops.assembly."""
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+        raise NativeBuildError(f"native runtime unavailable: {_build_error}")
     shape = problem.node_shape
     a = np.zeros(shape, np.float64)
     b = np.zeros(shape, np.float64)
