@@ -57,9 +57,10 @@ def main(argv=None) -> int:
         "--engine",
         choices=ENGINES,
         default="auto",
-        help="single-device solver engine: auto picks the fastest that "
+        help="solver engine. Single-device: auto picks the fastest that "
         "fits (resident -> streamed -> xla); fused is the two-kernel "
-        "HBM iteration",
+        "HBM iteration, pallas the per-op stencil kernel. Sharded mode: "
+        "xla (default) or pallas (the per-shard stencil kernel)",
     )
     ap.add_argument(
         "--threads",

@@ -177,17 +177,20 @@ def run_once(
             fence(args)
         shape = (1, 1)
     elif mode == "sharded":
-        if engine not in ("auto", "xla"):
+        if engine not in ("auto", "xla", "pallas"):
             raise ValueError(
-                f"engine {engine!r} is single-device only; the sharded "
-                "mode runs the XLA block stencil (engine 'xla')"
+                f"engine {engine!r} is single-device only; sharded mode "
+                "runs the XLA block stencil ('xla', default) or the "
+                "per-shard Pallas stencil kernel ('pallas')"
             )
+        engine = "xla" if engine == "auto" else engine
         with timer.phase("init"):
             mesh = resolve_mesh(mesh_shape)
-            solver, args = build_sharded_solver(problem, mesh, jdtype)
+            solver, args = build_sharded_solver(
+                problem, mesh, jdtype, stencil_impl=engine
+            )
             fence(args)
         shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
-        engine = "xla"
     else:
         raise ValueError(f"unknown mode: {mode!r}")
 

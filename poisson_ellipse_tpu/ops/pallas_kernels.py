@@ -124,11 +124,16 @@ def _stencil_kernel(h1, h2, tm, bn, w_hbm, a_hbm, b_hbm, out_ref, w_s, a_s, b_s,
     out_ref[:] = ax + ay
 
 
-def apply_a_block_pallas(w_ext, a_ext, b_ext, h1, h2, interpret=None):
+def apply_a_block_pallas(w_ext, a_ext, b_ext, h1, h2, interpret=None,
+                         vma=None):
     """A·w over a halo-extended block: (bm+2, bn+2) inputs → (bm, bn).
 
     Pallas twin of ``ops.stencil.apply_a_block`` (bit-compatible FP form:
     each difference divided by h before combining, as the reference does).
+
+    ``vma``: mesh axis names the output varies over — required when the
+    kernel runs per-shard inside ``jax.shard_map`` (whose vma checking
+    needs every pallas_call out_shape annotated).
 
     Each TM-row output tile DMAs an aligned (TM+8)-row input window —
     Mosaic requires HBM slice offsets/sizes 8-row-aligned, so a bare
@@ -166,7 +171,11 @@ def apply_a_block_pallas(w_ext, a_ext, b_ext, h1, h2, interpret=None):
         out_specs=pl.BlockSpec(
             (tm, bn), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((k, bn), dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((k, bn), dtype)
+            if vma is None
+            else jax.ShapeDtypeStruct((k, bn), dtype, vma=frozenset(vma))
+        ),
         scratch_shapes=[
             pltpu.VMEM((tm + 8, cols), dtype),
             pltpu.VMEM((tm + 8, cols), dtype),

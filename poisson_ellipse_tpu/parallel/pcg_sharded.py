@@ -39,10 +39,19 @@ from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
 
 
 def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
-               a_ext, b_ext, rhs_blk, dtype):
+               a_ext, b_ext, rhs_blk, dtype, stencil_impl: str = "xla",
+               interpret: bool = False):
     """Per-device PCG body. Runs inside shard_map; a_ext/b_ext are the
     device's halo-extended (bm+2, bn+2) coefficient blocks, rhs_blk its
-    owned (bm, bn) RHS block."""
+    owned (bm, bn) RHS block.
+
+    stencil_impl "pallas" runs the explicit VMEM-tiled stencil kernel
+    (``ops.pallas_kernels.apply_a_block_pallas``) on each shard every
+    iteration — the reference stage4's structure exactly: a device kernel
+    per rank in the hot loop, ringed by halo exchange and scalar
+    collectives (``apply_A_kernel`` inside ``gradient_solver_mpi``,
+    ``poisson_mpi_cuda2.cu:507-536``, ``:846-939``). "xla" leaves the
+    stencil to XLA's fusion (the default; same math, same FP form)."""
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     delta = jnp.asarray(problem.delta, dtype)
@@ -59,9 +68,30 @@ def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
     d = jnp.where(interior, diag_d_block(a_ext, b_ext, h1, h2), 0.0)
     maskd = interior.astype(dtype)
 
-    def stencil(p):
-        p_ext = halo_extend(p, px, py)
-        return apply_a_block(p_ext, a_ext, b_ext, h1, h2) * maskd
+    if stencil_impl == "pallas":
+        from poisson_ellipse_tpu.ops.pallas_kernels import apply_a_block_pallas
+
+        def stencil(p):
+            p_ext = halo_extend(p, px, py)
+            # grid spacings as python floats: the kernel bakes them in as
+            # compile-time constants (they never reach SMEM)
+            return (
+                apply_a_block_pallas(
+                    p_ext, a_ext, b_ext, problem.h1, problem.h2,
+                    interpret=interpret,
+                    vma=(AXIS_X, AXIS_Y),
+                )
+                * maskd
+            )
+
+    elif stencil_impl == "xla":
+
+        def stencil(p):
+            p_ext = halo_extend(p, px, py)
+            return apply_a_block(p_ext, a_ext, b_ext, h1, h2) * maskd
+
+    else:
+        raise ValueError(f"unknown stencil_impl: {stencil_impl!r}")
 
     def pdot(u, v):
         return lax.psum(jnp.sum(u * v), (AXIS_X, AXIS_Y)) * h1 * h2
@@ -129,6 +159,7 @@ def build_sharded_solver(
     mesh: Mesh | None = None,
     dtype=jnp.float32,
     assembly_mode: str = "host",
+    stencil_impl: str = "xla",
 ):
     """Return (jitted solver_fn, args) for the mesh-sharded solve.
 
@@ -140,11 +171,20 @@ def build_sharded_solver(
                  global indices inside shard_map, zero communication
                  (args = ()); use with f64 traces — see
                  ``ops.assembly.assemble_numpy`` for the f32 hazard.
+    stencil_impl:
+      "xla"    — XLA-fused block stencil (default).
+      "pallas" — explicit Pallas stencil kernel per shard per iteration
+                 (decomposition × device kernels in one program — the
+                 stage4 composition; see ``_local_pcg``).
     """
     if mesh is None:
         mesh = make_mesh()
     px = mesh.shape[AXIS_X]
     py = mesh.shape[AXIS_Y]
+    # interpret is a property of the MESH devices, not the process default
+    # backend: a TPU-default process dry-running on a virtual CPU mesh
+    # (the driver's multichip gate) must interpret, and vice versa
+    interpret = mesh.devices.flat[0].platform != "tpu"
     g1p, g2p = padded_dims(problem.node_shape, mesh)
     bm, bn = g1p // px, g2p // py
     spec = P(AXIS_X, AXIS_Y)
@@ -157,14 +197,20 @@ def build_sharded_solver(
             a_ext = halo_extend(a_blk, px, py)
             b_ext = halo_extend(b_blk, px, py)
             return _local_pcg(
-                problem, px, py, bm, bn, a_ext, b_ext, rhs_blk, dtype
+                problem, px, py, bm, bn, a_ext, b_ext, rhs_blk, dtype,
+                stencil_impl=stencil_impl, interpret=interpret,
             )
 
+        # check_vma off only for the interpret-mode pallas stencil: its
+        # internals mix varying refs with unvarying index values, which
+        # the vma checker rejects (the kernel itself is per-shard pure);
+        # compiled TPU runs keep full vma checking
         mapped = jax.shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=(spec, P(), P(), P(), P()),
+            check_vma=not (stencil_impl == "pallas" and interpret),
         )
 
         a, b, rhs = assembly.assemble_numpy(problem)
@@ -188,7 +234,8 @@ def build_sharded_solver(
                 problem, gi_ext[1:-1], gj_ext[1:-1], dtype
             )
             return _local_pcg(
-                problem, px, py, bm, bn, a_ext, b_ext, rhs_blk, dtype
+                problem, px, py, bm, bn, a_ext, b_ext, rhs_blk, dtype,
+                stencil_impl=stencil_impl, interpret=interpret,
             )
 
         mapped = jax.shard_map(
@@ -196,6 +243,7 @@ def build_sharded_solver(
             mesh=mesh,
             in_specs=(),
             out_specs=(spec, P(), P(), P(), P()),
+            check_vma=not (stencil_impl == "pallas" and interpret),
         )
         args = ()
     else:
@@ -219,9 +267,12 @@ def solve_sharded(
     mesh: Mesh | None = None,
     dtype=jnp.float32,
     assembly_mode: str = "host",
+    stencil_impl: str = "xla",
 ) -> PCGResult:
     """Assemble, shard and solve over the mesh (all devices by default)."""
-    solver, args = build_sharded_solver(problem, mesh, dtype, assembly_mode)
+    solver, args = build_sharded_solver(
+        problem, mesh, dtype, assembly_mode, stencil_impl=stencil_impl
+    )
     return solver(*args)
 
 

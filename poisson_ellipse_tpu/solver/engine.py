@@ -13,6 +13,9 @@ best path by default:
   streamed     state in VMEM, ops streamed   ~1.9x     (<= ~2400x3200)
   fused        two-kernel HBM iteration      ~1.2x     (small-mid grids)
   xla          lax.while_loop, XLA-fused     1.0x      (any grid, any dtype)
+  pallas       XLA loop + per-op Pallas      ~1.0x     (comparison engine:
+               stencil kernel                           stage4's kernel-per-
+                                                        op structure)
 
 Policy (``select_engine``): resident if the whole working set fits VMEM;
 else streamed if the state fits; else xla. f64 always takes xla — the
@@ -32,7 +35,7 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 # the Pallas engine modules import solver.pcg at their top level (which
 # runs this package's __init__), so they are imported lazily here
 
-ENGINES = ("auto", "xla", "fused", "resident", "streamed")
+ENGINES = ("auto", "xla", "fused", "resident", "streamed", "pallas")
 
 
 def select_engine(problem: Problem, dtype=jnp.float32) -> str:
@@ -72,11 +75,16 @@ def build_solver(
         from poisson_ellipse_tpu.ops.fused_pcg import build_fused_solver
 
         solver, args = build_fused_solver(problem, dtype, interpret=interpret)
-    elif engine == "xla":
+    elif engine in ("xla", "pallas"):
+        # "pallas" = the XLA while_loop driving the per-op Pallas stencil
+        # kernel (stage4's one-kernel-per-op structure on one chip)
         import jax
 
         a, b, rhs = assembly.assemble(problem, dtype)
-        solver = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))
+        stencil = engine
+        solver = jax.jit(
+            lambda a, b, rhs: pcg(problem, a, b, rhs, stencil=stencil)
+        )
         args = (a, b, rhs)
     else:
         raise ValueError(f"unknown engine: {engine!r} (choose from {ENGINES})")
