@@ -53,7 +53,8 @@ def bench_grid(M: int, N: int, oracle: int):
     print(
         f"  {M}x{N}: T_solver={report.t_solver:.4f}s iters={report.iters} "
         f"(oracle {oracle}) converged={report.converged} "
-        f"engine={report.engine} l2_err={report.l2_error:.3e}",
+        f"engine={report.engine} l2_err={report.l2_error:.3e}  "
+        + report.roofline_line(),
         file=sys.stderr,
     )
     return report.t_solver, ok

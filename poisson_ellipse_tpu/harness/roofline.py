@@ -1,0 +1,118 @@
+"""Bytes-per-iteration roofline model: achieved HBM bandwidth per engine.
+
+The reference's stage4 report attributes time to named phases (T_gpu,
+T_copy, T_mpi, T_prec, T_dot — ``poisson_mpi_cuda2.cu:696-700``) but never
+relates them to what the hardware could do. Here every run carries the
+next level: modelled HBM array-passes per PCG iteration for the engine
+that executed, the achieved streaming bandwidth they imply, and the
+fraction of the chip's HBM roofline that represents. A resident-engine
+row showing ~0 passes/iter is the point: that engine left the HBM
+roofline entirely (its iterations are VMEM/VPU-bound), which is why it
+outruns the XLA path several-fold.
+
+The pass counts are a traffic *model* (array reads + writes the
+iteration must stream from/to HBM, assuming perfect fusion of
+elementwise consumers), not a measurement; they use unpadded node-array
+bytes, so the implied GB/s slightly understates true traffic on padded
+layouts. Small grids report low roofline fractions because fixed
+per-iteration overheads (kernel launch, loop bookkeeping) dominate —
+the number quantifies exactly how far from streaming-bound a
+configuration is.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+
+# Published peak HBM bandwidth by device kind (bytes/s).
+_HBM_PEAK = {
+    "TPU v4": 1_228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2_765e9,
+    "TPU v5p": 2_765e9,
+    "TPU v6 lite": 1_640e9,
+    "TPU v6e": 1_640e9,
+}
+
+
+def hbm_peak_bytes_per_s(device=None) -> Optional[float]:
+    """Peak HBM bandwidth of the (default) device, or None if unknown."""
+    if device is None:
+        devices = jax.devices()
+        if not devices:
+            return None
+        device = devices[0]
+    return _HBM_PEAK.get(getattr(device, "device_kind", ""), None)
+
+
+def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
+    """Modelled HBM array-passes per PCG iteration for one engine.
+
+    One "pass" = one full node-array read or write against HBM.
+
+      xla / pallas — every iterate and operand streams each use:
+        stencil (read p, a, b; write ap)                      4
+        denom dot (read ap, p — assume fused into stencil)    0
+        w/r update (read w, r, p, ap; write w, r)             6
+        z = r * dinv (read r?, dinv; write z — r fused)       2
+        zr dot (fused into z)                                 0
+        p = z + beta*p (read z?, p; write p — z fused)        1
+        => ~13 passes (matches the measured HBM-bound regime)
+      fused — K1 reads z, p, 5 coefficient arrays, writes pn, ap (9);
+        K2 reads w, r, pn, ap, dinv, writes w, r, z (8) => 17
+        (more traffic than xla — why it only wins while compute-bound)
+      resident — HBM touched twice per *solve*, not per iteration => 0
+      streamed — state is VMEM-resident; only non-resident operands
+        stream (``StreamPlan.streamed_passes_per_iter``)
+    """
+    if engine in ("xla", "pallas"):
+        return 13.0
+    if engine == "fused":
+        return 17.0
+    if engine == "resident":
+        return 0.0
+    if engine == "streamed":
+        from poisson_ellipse_tpu.ops.streamed_pcg import StreamPlan
+
+        return StreamPlan(problem, dtype).streamed_passes_per_iter()
+    raise ValueError(f"no traffic model for engine {engine!r}")
+
+
+def roofline(
+    problem: Problem,
+    engine: str,
+    iters: int,
+    t_solver: float,
+    dtype=jnp.float32,
+    device=None,
+    n_devices: int = 1,
+) -> dict:
+    """Achieved per-device GB/s + fraction-of-HBM-peak for a measured solve.
+
+    Returns {"passes_per_iter", "hbm_gbps", "hbm_peak_frac"} —
+    hbm_peak_frac is None when the device's peak is unknown (CPU runs).
+    For sharded runs (n_devices > 1) the global traffic divides over the
+    mesh, so the figures are per-chip utilisation against one chip's
+    peak; halo-exchange bytes (ICI, not HBM) are not modelled.
+    """
+    g1, g2 = problem.node_shape
+    array_bytes = g1 * g2 * jnp.dtype(dtype).itemsize
+    passes = passes_per_iter(problem, engine, dtype)
+    bytes_per_dev = passes * array_bytes * max(iters, 1) / max(n_devices, 1)
+    gbps = bytes_per_dev / t_solver / 1e9 if t_solver > 0 else 0.0
+    peak = hbm_peak_bytes_per_s(device)
+    return {
+        "passes_per_iter": passes,
+        "hbm_gbps": round(gbps, 2),
+        "hbm_peak_frac": (
+            round(bytes_per_dev / t_solver / peak, 4)
+            if peak and t_solver > 0
+            else None
+        ),
+    }

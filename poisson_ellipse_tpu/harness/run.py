@@ -80,6 +80,12 @@ class RunReport:
     t_init: float
     t_solver: float
     times: list[float] = field(default_factory=list)
+    # bytes-per-iteration roofline (harness.roofline): modelled HBM
+    # passes/iter for the engine, the achieved GB/s they imply, and the
+    # fraction of the chip's HBM peak (None when the peak is unknown)
+    passes_per_iter: float = 0.0
+    hbm_gbps: float = 0.0
+    hbm_peak_frac: float | None = None
 
     def summary(self) -> str:
         p = self.problem
@@ -109,7 +115,26 @@ class RunReport:
             ),
             f"L2 error vs analytic: {self.l2_error:.6e}",
         ]
+        line = self.roofline_line()
+        if line:
+            lines.append(line)
         return "\n".join(lines)
+
+    def roofline_line(self) -> str:
+        """One-line roofline summary, '' when the model does not apply
+        (native host runs, zero iterations)."""
+        if not self.iters or self.engine == "native":
+            return ""
+        frac = (
+            f"  ({self.hbm_peak_frac:.1%} of HBM peak)"
+            if self.hbm_peak_frac is not None
+            else ""
+        )
+        return (
+            f"Roofline: {self.t_solver / self.iters * 1e6:.1f} us/iter, "
+            f"{self.passes_per_iter:g} HBM passes/iter -> "
+            f"{self.hbm_gbps:.0f} GB/s{frac}"
+        )
 
     def json_dict(self) -> dict:
         p = self.problem
@@ -127,6 +152,9 @@ class RunReport:
             "l2_error": self.l2_error,
             "t_init_s": self.t_init,
             "t_solver_s": self.t_solver,
+            "passes_per_iter": self.passes_per_iter,
+            "hbm_gbps": self.hbm_gbps,
+            "hbm_peak_frac": self.hbm_peak_frac,
         }
 
 
@@ -235,6 +263,16 @@ def run_once(
     with timer.phase("finalize"):
         l2 = float(l2_error_vs_analytic(problem, result.w))
 
+    from poisson_ellipse_tpu.harness.roofline import roofline
+
+    roof = roofline(
+        problem,
+        engine,
+        int(result.iters),
+        timer.totals["solver"],
+        jdtype,
+        n_devices=shape[0] * shape[1],
+    )
     return RunReport(
         problem=problem,
         mesh_shape=shape,
@@ -248,6 +286,7 @@ def run_once(
         t_init=timer.totals["init"],
         t_solver=timer.totals["solver"],
         times=times,
+        **roof,
     )
 
 

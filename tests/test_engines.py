@@ -220,3 +220,40 @@ def test_run_once_engine_auto_reports_engine():
     assert report.engine == "resident"
     assert report.iters == WEIGHTED_ORACLE[(20, 20)]
     assert report.converged
+
+
+# ---------------------------------------------------------------- roofline
+
+
+def test_roofline_passes_model():
+    from poisson_ellipse_tpu.harness.roofline import passes_per_iter, roofline
+
+    p_small = Problem(M=40, N=40)
+    assert passes_per_iter(p_small, "resident") == 0.0
+    assert passes_per_iter(p_small, "xla") == 13.0
+    assert passes_per_iter(p_small, "fused") == 17.0
+    # streamed: a fully resident plan streams nothing
+    assert passes_per_iter(p_small, "streamed") == 0.0
+    big = Problem(M=2400, N=3200)
+    plan = StreamPlan(big, jnp.float32)
+    assert passes_per_iter(big, "streamed") == pytest.approx(
+        plan.streamed_passes_per_iter()
+    )
+    assert plan.streamed_passes_per_iter() > 0
+    with pytest.raises(ValueError, match="traffic model"):
+        passes_per_iter(p_small, "cuda")
+
+    # 13 passes * 41*41*4 bytes * 10 iters in 1 ms => 0.874 GB/s
+    r = roofline(p_small, "xla", iters=10, t_solver=1e-3, dtype=jnp.float32)
+    assert r["hbm_gbps"] == pytest.approx(0.874, rel=1e-2)
+    # CPU test runs have no known HBM peak
+    assert r["hbm_peak_frac"] is None
+
+
+def test_run_once_carries_roofline():
+    report = run_once(Problem(M=20, N=20), mode="single", engine="xla")
+    assert report.passes_per_iter == 13.0
+    assert report.hbm_gbps > 0
+    rec = report.json_dict()
+    assert {"passes_per_iter", "hbm_gbps", "hbm_peak_frac"} <= set(rec)
+    assert "Roofline:" in report.summary()
