@@ -1,0 +1,131 @@
+"""Multi-chip scaling tables in the reference stage4 report format.
+
+The stage4 report's table 1 (Этап_4_1213.pdf p.11; BASELINE.md "Stage 4")
+rows are (grid, config, iters, T_solver, speedup-vs-reference-config);
+its weak-scaling discussion compares per-device-constant workloads. This
+module emits the same tables for a TPU mesh:
+
+  strong scaling — one grid, growing mesh; speedup vs the first row,
+    parallel efficiency = speedup / devices.
+  weak scaling — per-device block constant: mesh (px, py) solves the
+    (M0*px, N0*py) grid; efficiency = T(first row) / T(row) (ideal 1.0).
+
+BASELINE.json configs 3/4 are one weak series from base 2048x2048:
+mesh 1x1 -> 2048², 2x2 -> 4096², 4x4 -> 8192². On hardware:
+``python bench_multichip.py --kind weak --grid 2048x2048 --meshes
+1x1,2x2,4x4``. The same tables run on the virtual CPU mesh (scaled-down
+grids) for CI — the reference analogously sanity-runs 40x40 at 1/2/4
+mpirun ranks (Этап2.pdf table 1).
+
+Iteration counts must be mesh-invariant (the reference's
+cross-implementation oracle): every emitted table carries
+``iters_consistent`` so a parity break is machine-visible.
+"""
+
+from __future__ import annotations
+
+from poisson_ellipse_tpu.harness.run import run_once
+from poisson_ellipse_tpu.models.problem import Problem
+
+# the exact per-row key set (pinned by tests; downstream parsers rely on it)
+ROW_SCHEMA = frozenset(
+    {
+        "grid",
+        "mesh",
+        "devices",
+        "iters",
+        "converged",
+        "t_solver_s",
+        "l2_error",
+        "speedup",
+        "efficiency",
+        "hbm_gbps",
+    }
+)
+
+
+def _row(report, t_first: float | None, devices_first: int, weak: bool) -> dict:
+    t = report.t_solver
+    devices = report.mesh_shape[0] * report.mesh_shape[1]
+    if t_first is None or t <= 0:
+        speedup, efficiency = 1.0, 1.0
+    else:
+        # both columns are relative to the table's FIRST row (which need
+        # not be 1 device — a grid may not fit one chip): ideal strong
+        # scaling from d0 to d devices is speedup d/d0, efficiency 1.0
+        speedup = t_first / t
+        efficiency = speedup if weak else speedup * devices_first / devices
+    p = report.problem
+    return {
+        "grid": f"{p.M}x{p.N}",
+        "mesh": list(report.mesh_shape),
+        "devices": devices,
+        "iters": report.iters,
+        "converged": report.converged,
+        "t_solver_s": round(t, 6),
+        "l2_error": report.l2_error,
+        "speedup": round(speedup, 3),
+        "efficiency": round(efficiency, 3),
+        "hbm_gbps": report.hbm_gbps,
+    }
+
+
+def scaling_table(
+    kind: str,
+    base_grid: tuple[int, int],
+    meshes: list[tuple[int, int]],
+    dtype: str = "f32",
+    stencil_impl: str = "xla",
+    repeat: int = 1,
+    batch: int = 1,
+) -> dict:
+    """Run one scaling series and emit the stage4-format table.
+
+    kind "strong": every mesh solves base_grid. kind "weak": mesh
+    (px, py) solves (M0*px, N0*py) — constant per-device block.
+    """
+    if kind not in ("strong", "weak"):
+        raise ValueError(f"kind must be 'strong' or 'weak', got {kind!r}")
+    weak = kind == "weak"
+    M0, N0 = base_grid
+    rows = []
+    t_first = None
+    devices_first = meshes[0][0] * meshes[0][1]
+    for px, py in meshes:
+        problem = Problem(
+            M=M0 * px if weak else M0, N=N0 * py if weak else N0
+        )
+        report = run_once(
+            problem,
+            mode="sharded",
+            mesh_shape=(px, py),
+            dtype=dtype,
+            engine=stencil_impl,
+            repeat=repeat,
+            batch=batch,
+        )
+        rows.append(_row(report, t_first, devices_first, weak))
+        if t_first is None:
+            t_first = report.t_solver
+    return {
+        "kind": kind,
+        "base_grid": f"{M0}x{N0}",
+        "dtype": dtype,
+        "stencil_impl": stencil_impl,
+        "rows": rows,
+        # the reference's oracle: same grid -> same iteration count on
+        # every mesh (only meaningful for strong scaling, where the grid
+        # is fixed across rows)
+        "iters_consistent": (
+            len({r["iters"] for r in rows}) <= 1 if not weak else None
+        ),
+    }
+
+
+def parse_meshes(spec: str) -> list[tuple[int, int]]:
+    """'1x1,2x2,2x4' -> [(1,1), (2,2), (2,4)]."""
+    out = []
+    for part in spec.split(","):
+        px, _, py = part.lower().partition("x")
+        out.append((int(px), int(py or px)))
+    return out
