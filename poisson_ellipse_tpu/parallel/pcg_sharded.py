@@ -38,12 +38,11 @@ from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh, padded_
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
 
 
-def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
-               a_ext, b_ext, rhs_blk, dtype, stencil_impl: str = "xla",
+def _shard_ops(problem: Problem, px: int, py: int, bm: int, bn: int,
+               a_ext, b_ext, dtype, stencil_impl: str = "xla",
                interpret: bool = False):
-    """Per-device PCG body. Runs inside shard_map; a_ext/b_ext are the
-    device's halo-extended (bm+2, bn+2) coefficient blocks, rhs_blk its
-    owned (bm, bn) RHS block.
+    """(stencil, pdot, d) closures for one shard — shared by the
+    whole-solve and chunked-advance paths.
 
     stencil_impl "pallas" runs the explicit VMEM-tiled stencil kernel
     (``ops.pallas_kernels.apply_a_block_pallas``) on each shard every
@@ -54,8 +53,6 @@ def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
     stencil to XLA's fusion (the default; same math, same FP form)."""
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
-    delta = jnp.asarray(problem.delta, dtype)
-    weighted = problem.norm == "weighted"
 
     ix = lax.axis_index(AXIS_X)
     iy = lax.axis_index(AXIS_Y)
@@ -96,6 +93,14 @@ def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
     def pdot(u, v):
         return lax.psum(jnp.sum(u * v), (AXIS_X, AXIS_Y)) * h1 * h2
 
+    return stencil, pdot, d
+
+
+def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
+                pdot, d, rhs_blk, dtype):
+    """The full PCG carry at iteration 0 on one shard — layout matches
+    ``solver.pcg.init_state`` (k, w, r, p, zr, diff, converged,
+    breakdown), with w/r/p as per-shard blocks and replicated scalars."""
     # the zeros literal is device-invariant; mark it varying over the mesh so
     # the while_loop carry type matches the (varying) per-device updates
     w0 = lax.pcast(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y), to="varying")
@@ -103,10 +108,39 @@ def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
     z0 = apply_dinv(r0, d)
     p0 = z0
     zr0 = pdot(z0, r0)
+    return (
+        jnp.asarray(0, jnp.int32),
+        w0,
+        r0,
+        p0,
+        zr0,
+        jnp.asarray(jnp.inf, dtype),
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+
+
+def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
+                   limit=None):
+    """Advance the sharded PCG carry until convergence/breakdown or
+    iteration ``limit`` (defaults to max_iterations). Chunking only moves
+    the while_loop boundary, not the arithmetic — same contract as
+    ``solver.pcg.advance``."""
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    delta = jnp.asarray(problem.delta, dtype)
+    weighted = problem.norm == "weighted"
+    max_iter = (
+        problem.max_iterations
+        if limit is None
+        else jnp.minimum(
+            jnp.asarray(limit, jnp.int32), problem.max_iterations
+        )
+    )
 
     def cond(state):
         k, _w, _r, _p, _zr, _diff, converged, breakdown = state
-        return (k < problem.max_iterations) & ~converged & ~breakdown
+        return (k < max_iter) & ~converged & ~breakdown
 
     def body(state):
         k, w, r, p, zr, _diff, _c, _bd = state
@@ -138,18 +172,22 @@ def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
         zr_out = jnp.where(breakdown | converged, zr, zr_new)
         return (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
 
-    state0 = (
-        jnp.asarray(0, jnp.int32),
-        w0,
-        r0,
-        p0,
-        zr0,
-        jnp.asarray(jnp.inf, dtype),
-        jnp.asarray(False),
-        jnp.asarray(False),
+    return lax.while_loop(cond, body, state)
+
+
+def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
+               a_ext, b_ext, rhs_blk, dtype, stencil_impl: str = "xla",
+               interpret: bool = False):
+    """Per-device whole solve (init + advance to the iteration cap).
+    Runs inside shard_map; a_ext/b_ext are the device's halo-extended
+    (bm+2, bn+2) coefficient blocks, rhs_blk its owned (bm, bn) RHS
+    block."""
+    stencil, pdot, d = _shard_ops(
+        problem, px, py, bm, bn, a_ext, b_ext, dtype, stencil_impl, interpret
     )
-    k, w, _r, _p, _zr, diff, converged, breakdown = lax.while_loop(
-        cond, body, state0
+    state0 = _shard_init(problem, px, py, bm, bn, pdot, d, rhs_blk, dtype)
+    k, w, _r, _p, _zr, diff, converged, breakdown = _shard_advance(
+        problem, stencil, pdot, d, state0, dtype
     )
     return w, k, diff, converged, breakdown
 
