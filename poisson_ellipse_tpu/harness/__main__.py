@@ -100,6 +100,18 @@ def main(argv=None) -> int:
         help="dispatches per repetition (amortises host<->device RTT)",
     )
     ap.add_argument(
+        "--checkpoint-dir",
+        help="persist the PCG carry here every --chunk iterations and "
+        "resume from it after a kill (single and sharded modes; sharded "
+        "carries are saved with their mesh shardings)",
+    )
+    ap.add_argument(
+        "--chunk",
+        type=int,
+        default=500,
+        help="iterations between checkpoints (with --checkpoint-dir)",
+    )
+    ap.add_argument(
         "--profile",
         action="store_true",
         help="segmented per-phase iteration profile (stage4 timer taxonomy)",
@@ -118,9 +130,22 @@ def main(argv=None) -> int:
         else [args.eps]
     )
 
+    grids = _parse_grids(args)
+    # a sweep re-fingerprints the checkpoint each run, so a shared directory
+    # would refuse every run after the first — key per-run subdirectories
+    sweeping = len(grids) * len(eps_values) > 1
+
     rc = 0
-    for M, N in _parse_grids(args):
+    for M, N in grids:
         for eps in eps_values:
+            ck_dir = args.checkpoint_dir
+            if ck_dir is not None and sweeping:
+                import os
+
+                ck_dir = os.path.join(
+                    ck_dir,
+                    f"{M}x{N}" + (f"_eps{eps:g}" if eps is not None else ""),
+                )
             problem = Problem(
                 M=M,
                 N=N,
@@ -150,6 +175,8 @@ def main(argv=None) -> int:
                         repeat=args.repeat,
                         batch=args.batch,
                         threads=args.threads,
+                        checkpoint_dir=ck_dir,
+                        chunk=args.chunk,
                     )
             except (ValueError, NativeBuildError) as e:
                 # NativeBuildError = g++ missing or the C++ build failed —

@@ -167,6 +167,8 @@ def run_once(
     repeat: int = 1,
     batch: int = 1,
     threads: int = 0,
+    checkpoint_dir: str | None = None,
+    chunk: int = 500,
 ) -> RunReport:
     """Assemble + solve with fenced init/solver timing.
 
@@ -189,6 +191,8 @@ def run_once(
     time.
     """
     if mode == "native":
+        if checkpoint_dir is not None:
+            raise ValueError("checkpointing covers the JAX paths, not native")
         return _run_native(problem, repeat=repeat, threads=threads)
     jdtype = resolve_dtype(dtype)
     if mode == "auto":
@@ -196,6 +200,19 @@ def run_once(
             "sharded"
             if mesh_shape is not None or len(jax.devices()) > 1
             else "single"
+        )
+    if mode not in ("single", "sharded"):
+        raise ValueError(f"unknown mode: {mode!r}")
+    if checkpoint_dir is not None:
+        if repeat > 1 or batch > 1:
+            raise ValueError(
+                "checkpointed runs are one wall-clocked chunked solve; "
+                "the repeat/batch timing protocol does not apply "
+                "(drop --repeat/--batch or --checkpoint-dir)"
+            )
+        return _run_checkpointed(
+            problem, mode, mesh_shape, dtype, jdtype, engine,
+            checkpoint_dir, chunk,
         )
 
     timer = PhaseTimer()
@@ -219,7 +236,7 @@ def run_once(
             )
             fence(args)
         shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
-    else:
+    else:  # unreachable: mode validated above
         raise ValueError(f"unknown mode: {mode!r}")
 
     # compile + warm-up outside the timed region (the reference likewise
@@ -314,6 +331,72 @@ def _chain_solver(solver, args, n: int):
         return solver(*a[:-1], r0 * (1.0 + tiny * acc))
 
     return jax.jit(chained)
+
+
+def _run_checkpointed(
+    problem: Problem,
+    mode: str,
+    mesh_shape,
+    dtype: str,
+    jdtype,
+    engine: str,
+    directory: str,
+    chunk: int,
+) -> RunReport:
+    """One checkpointed solve (resumes from ``directory`` if it holds a
+    matching checkpoint). Timing here is a plain wall clock around the
+    chunked run — a checkpointed solve trades peak dispatch efficiency for
+    restartability, so it is not the protocol the bench numbers use."""
+    from poisson_ellipse_tpu.solver.checkpoint import CheckpointingSolver
+
+    if engine == "auto":
+        engine = "xla"
+    if engine not in ("xla", "pallas"):
+        raise ValueError(
+            "checkpointed runs persist the XLA-loop PCG carry; "
+            "--engine must be xla or pallas (the per-op/per-shard stencil "
+            f"kernel), got {engine!r}"
+        )
+    timer = PhaseTimer()
+    with timer.phase("init"):
+        mesh = resolve_mesh(mesh_shape) if mode == "sharded" else None
+        solver = CheckpointingSolver(
+            problem, directory, chunk=chunk, dtype=jdtype, stencil=engine,
+            mesh=mesh,
+        )
+    shape = (
+        (mesh.shape[AXIS_X], mesh.shape[AXIS_Y]) if mesh is not None else (1, 1)
+    )
+    with solver:
+        t0 = time.perf_counter()
+        result = solver.run()
+        fence(result)
+        t_solve = time.perf_counter() - t0
+    timer.add("solver", t_solve)
+    with timer.phase("finalize"):
+        l2 = float(l2_error_vs_analytic(problem, result.w))
+
+    from poisson_ellipse_tpu.harness.roofline import roofline
+
+    roof = roofline(
+        problem, engine, int(result.iters), t_solve, jdtype,
+        n_devices=shape[0] * shape[1],
+    )
+    return RunReport(
+        problem=problem,
+        mesh_shape=shape,
+        dtype=dtype,
+        engine=engine,
+        iters=int(result.iters),
+        converged=bool(result.converged),
+        breakdown=bool(result.breakdown),
+        diff=float(result.diff),
+        l2_error=l2,
+        t_init=timer.totals["init"],
+        t_solver=t_solve,
+        times=[t_solve],
+        **roof,
+    )
 
 
 def _run_native(problem: Problem, repeat: int, threads: int) -> RunReport:

@@ -251,15 +251,7 @@ def build_sharded_solver(
             check_vma=not (stencil_impl == "pallas" and interpret),
         )
 
-        a, b, rhs = assembly.assemble_numpy(problem)
-        np_dtype = assembly.numpy_dtype(dtype)
-        sharding = NamedSharding(mesh, spec)
-        args = tuple(
-            jax.device_put(
-                _pad_to(arr, g1p, g2p).astype(np_dtype), sharding
-            )
-            for arr in (a, b, rhs)
-        )
+        args = _host_sharded_args(problem, mesh, dtype, g1p, g2p, spec)
     elif assembly_mode == "device":
 
         def shard_fn():
@@ -300,6 +292,103 @@ def build_sharded_solver(
     return jax.jit(solver), args
 
 
+def build_sharded_stepper(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+    stencil_impl: str = "xla",
+):
+    """(init_fn, advance_fn) for chunked/resumable sharded solves.
+
+    ``init_fn() -> state`` builds the iteration-0 carry; ``advance_fn(state,
+    limit) -> state`` advances it until convergence/breakdown or iteration
+    ``limit`` (a traced scalar: chunked runs pass k+chunk per dispatch
+    without recompiling). The carry layout matches ``solver.pcg.init_state``
+    — (k, w, r, p, zr, diff, converged, breakdown) — with w/r/p as global
+    padded ``(g1p, g2p)`` arrays sharded ``P('x','y')`` over the mesh and
+    scalars replicated, which is exactly what ``solver.checkpoint``
+    persists through orbax (sharded carries save/restore with their
+    shardings intact). Chunking only moves the while_loop boundary, not
+    the arithmetic, so a chunked run converges in the same iteration count
+    as ``build_sharded_solver``'s straight solve.
+
+    The reference has no distributed checkpointing at all (SURVEY §5) —
+    its MPI runs are start-to-finish; this is the subsystem the long
+    sharded runs (the only ones long enough to need it) get natively.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    px = mesh.shape[AXIS_X]
+    py = mesh.shape[AXIS_Y]
+    interpret = mesh.devices.flat[0].platform != "tpu"
+    g1p, g2p = padded_dims(problem.node_shape, mesh)
+    bm, bn = g1p // px, g2p // py
+    spec = P(AXIS_X, AXIS_Y)
+    scalar = P()
+    state_specs = (scalar, spec, spec, spec, scalar, scalar, scalar, scalar)
+    check_vma = not (stencil_impl == "pallas" and interpret)
+
+    def init_shard(a_blk, b_blk, rhs_blk):
+        a_ext = halo_extend(a_blk, px, py)
+        b_ext = halo_extend(b_blk, px, py)
+        _stencil, pdot, d = _shard_ops(
+            problem, px, py, bm, bn, a_ext, b_ext, dtype,
+            stencil_impl, interpret,
+        )
+        return _shard_init(problem, px, py, bm, bn, pdot, d, rhs_blk, dtype)
+
+    def advance_shard(a_blk, b_blk, state, limit):
+        a_ext = halo_extend(a_blk, px, py)
+        b_ext = halo_extend(b_blk, px, py)
+        stencil, pdot, d = _shard_ops(
+            problem, px, py, bm, bn, a_ext, b_ext, dtype,
+            stencil_impl, interpret,
+        )
+        return _shard_advance(
+            problem, stencil, pdot, d, state, dtype, limit=limit
+        )
+
+    init_mapped = jax.jit(jax.shard_map(
+        init_shard,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=state_specs,
+        check_vma=check_vma,
+    ))
+    advance_mapped = jax.jit(jax.shard_map(
+        advance_shard,
+        mesh=mesh,
+        in_specs=(spec, spec, state_specs, scalar),
+        out_specs=state_specs,
+        check_vma=check_vma,
+    ))
+
+    args = _host_sharded_args(problem, mesh, dtype, g1p, g2p, spec)
+
+    def init_fn():
+        return init_mapped(*args)
+
+    def advance_fn(state, limit):
+        # args[2] is the RHS — consumed by init only; the carry holds r
+        return advance_mapped(
+            args[0], args[1], state, jnp.asarray(limit, jnp.int32)
+        )
+
+    return init_fn, advance_fn
+
+
+def sharded_result_of(problem: Problem, state) -> PCGResult:
+    """View a sharded PCG carry as a PCGResult (crops the shard padding)."""
+    k, w, _r, _p, _zr, diff, converged, breakdown = state
+    return PCGResult(
+        w=w[: problem.M + 1, : problem.N + 1],
+        iters=k,
+        diff=diff,
+        converged=converged,
+        breakdown=breakdown,
+    )
+
+
 def solve_sharded(
     problem: Problem,
     mesh: Mesh | None = None,
@@ -317,4 +406,17 @@ def solve_sharded(
 def _pad_to(arr, g1p: int, g2p: int):
     return np.pad(
         arr, ((0, g1p - arr.shape[0]), (0, g2p - arr.shape[1]))
+    )
+
+
+def _host_sharded_args(problem: Problem, mesh: Mesh, dtype,
+                       g1p: int, g2p: int, spec):
+    """Host-f64-assembled a/b/rhs, zero-padded to even shards and laid out
+    over the mesh (the "host" assembly mode's operand set)."""
+    a, b, rhs = assembly.assemble_numpy(problem)
+    np_dtype = assembly.numpy_dtype(dtype)
+    sharding = NamedSharding(mesh, spec)
+    return tuple(
+        jax.device_put(_pad_to(arr, g1p, g2p).astype(np_dtype), sharding)
+        for arr in (a, b, rhs)
     )

@@ -13,6 +13,13 @@ across jit boundaries).
 A checkpoint records a fingerprint of the Problem + dtype; resuming onto
 a different discretisation is refused rather than silently producing a
 mixed-state solve.
+
+Sharded solves checkpoint the same way: pass ``mesh=`` and the persisted
+carry is the mesh-sharded global state (w/r/p laid out ``P('x','y')``,
+scalars replicated) from ``parallel.pcg_sharded.build_sharded_stepper``.
+Orbax saves/restores the arrays with their shardings intact, so a killed
+multi-chip run resumes mid-solve on the same mesh — the runs long enough
+to need checkpointing are exactly the big sharded ones.
 """
 
 from __future__ import annotations
@@ -37,13 +44,17 @@ from poisson_ellipse_tpu.solver.pcg import (
 STATE_KEYS = ("k", "w", "r", "p", "zr", "diff", "converged", "breakdown")
 
 
-def _fingerprint(problem: Problem, dtype, stencil: str) -> dict:
+def _fingerprint(problem: Problem, dtype, stencil: str, mesh_shape) -> dict:
     fp = dataclasses.asdict(problem)
     fp["dtype"] = str(jnp.dtype(dtype))
     # the xla and pallas stencils agree only to 1-2 ulps, so resuming a
     # run under the other operator would be a silent mixed-arithmetic
     # solve — fingerprint it like the discretisation itself
     fp["stencil"] = stencil
+    # mesh shape fixes both the shard padding (array shapes) and the psum
+    # reduction grouping; a resume onto a different mesh would be a
+    # silently different f.p. computation
+    fp["mesh"] = list(mesh_shape)
     return fp
 
 
@@ -70,6 +81,7 @@ class CheckpointingSolver:
         dtype=jnp.float32,
         stencil: str = "xla",
         keep: int = 2,
+        mesh=None,
     ):
         import orbax.checkpoint as ocp
 
@@ -79,27 +91,43 @@ class CheckpointingSolver:
         self.chunk = chunk
         self.dtype = dtype
         self.stencil = stencil
+        self.mesh = mesh
         self.directory = os.path.abspath(directory)
-        self._fp = _fingerprint(problem, dtype, stencil)
+        if mesh is None:
+            self._a, self._b, self._rhs = assembly.assemble(problem, dtype)
+            self._init = lambda: init_state(
+                problem, self._a, self._b, self._rhs
+            )
+            # one compiled advance reused for every chunk: the bound rides
+            # in as a traced scalar
+            self._advance = jax.jit(
+                lambda state, limit: advance(
+                    problem,
+                    self._a,
+                    self._b,
+                    self._rhs,
+                    state,
+                    limit=limit,
+                    stencil=stencil,
+                )
+            )
+            mesh_shape = (1, 1)
+        else:
+            from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y
+            from poisson_ellipse_tpu.parallel.pcg_sharded import (
+                build_sharded_stepper,
+            )
+
+            self._init, self._advance = build_sharded_stepper(
+                problem, mesh, dtype, stencil_impl=stencil
+            )
+            mesh_shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
+        self._fp = _fingerprint(problem, dtype, stencil, mesh_shape)
         self._manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep, create=True
             ),
-        )
-        self._a, self._b, self._rhs = assembly.assemble(problem, dtype)
-        # one compiled advance reused for every chunk: the bound rides in
-        # as a traced scalar
-        self._advance = jax.jit(
-            lambda state, limit: advance(
-                problem,
-                self._a,
-                self._b,
-                self._rhs,
-                state,
-                limit=limit,
-                stencil=stencil,
-            )
         )
 
     # -- persistence --------------------------------------------------------
@@ -136,13 +164,14 @@ class CheckpointingSolver:
                 "checkpoint was written by a different problem/dtype: "
                 f"saved {meta}, current {self._fp}"
             )
+        # the freshly initialised carry is the restore template: it carries
+        # the exact dtypes, shapes and (for sharded runs) shardings the
+        # arrays must come back with
         restored = self._manager.restore(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardRestore(
-                    _state_to_tree(init_state(
-                        self.problem, self._a, self._b, self._rhs
-                    ))
+                    _state_to_tree(self._init())
                 ),
             ),
         )
@@ -161,7 +190,7 @@ class CheckpointingSolver:
         if step is not None:
             state = self._restore(step)
         else:
-            state = init_state(self.problem, self._a, self._b, self._rhs)
+            state = self._init()
 
         max_iter = self.problem.max_iterations
         while True:
@@ -175,6 +204,13 @@ class CheckpointingSolver:
                 state, jnp.asarray(k + self.chunk, jnp.int32)
             )
             self._save(state)
+        if self.mesh is not None:
+            from poisson_ellipse_tpu.parallel.pcg_sharded import (
+                sharded_result_of,
+            )
+
+            # sharded carries hold the padded global grid; crop to nodes
+            return sharded_result_of(self.problem, state)
         return result_of(state)
 
     def close(self) -> None:
@@ -196,9 +232,11 @@ def solve_with_checkpoints(
     dtype=jnp.float32,
     stencil: str = "xla",
     resume: bool = True,
+    mesh=None,
 ) -> PCGResult:
     """One-call form of CheckpointingSolver."""
     with CheckpointingSolver(
-        problem, directory, chunk=chunk, dtype=dtype, stencil=stencil
+        problem, directory, chunk=chunk, dtype=dtype, stencil=stencil,
+        mesh=mesh,
     ) as solver:
         return solver.run(resume=resume)

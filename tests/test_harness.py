@@ -116,3 +116,75 @@ def test_cli_native_backend(capsys):
     assert rc == 0
     rec = json.loads(capsys.readouterr().out.strip())
     assert rec["iters"] == 50 and rec["dtype"] == "f64"
+
+
+def test_cli_checkpointed_sharded_run(tmp_path, capsys):
+    ck = str(tmp_path / "ck")
+    argv = [
+        "40", "40", "--mode", "sharded", "--dtype", "f64",
+        "--checkpoint-dir", ck, "--chunk", "12", "--json",
+    ]
+    rc = cli_main(argv)
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["iters"] == 50 and rec["converged"] is True
+    assert rec["mesh"] == [2, 4]
+    # a second invocation resumes from the finished checkpoint: the carry
+    # is already converged, so it completes without re-iterating
+    rc = cli_main(argv)
+    assert rc == 0
+    rec2 = json.loads(capsys.readouterr().out.strip())
+    assert rec2["iters"] == 50 and rec2["converged"] is True
+
+
+def test_run_once_checkpointed_single(tmp_path):
+    report = run_once(
+        Problem(M=20, N=20),
+        mode="single",
+        dtype="f64",
+        checkpoint_dir=str(tmp_path / "ck"),
+        chunk=7,
+    )
+    assert report.iters == 26 and report.converged
+
+
+def test_run_once_checkpoint_rejects_vmem_engines(tmp_path):
+    with pytest.raises(ValueError, match="xla or pallas"):
+        run_once(
+            Problem(M=20, N=20),
+            mode="single",
+            engine="resident",
+            checkpoint_dir=str(tmp_path / "ck"),
+        )
+
+
+def test_cli_checkpoint_sweep_uses_per_run_subdirs(tmp_path):
+    ck = str(tmp_path / "ck")
+    rc = cli_main([
+        "--grids", "10x10,20x20", "--mode", "single", "--dtype", "f64",
+        "--checkpoint-dir", ck, "--chunk", "6", "--json",
+    ])
+    assert rc == 0
+    import os
+
+    assert os.path.isdir(os.path.join(ck, "10x10"))
+    assert os.path.isdir(os.path.join(ck, "20x20"))
+
+
+def test_run_once_checkpoint_rejects_repeat_batch(tmp_path):
+    with pytest.raises(ValueError, match="repeat/batch"):
+        run_once(
+            Problem(M=10, N=10),
+            mode="single",
+            checkpoint_dir=str(tmp_path / "ck"),
+            repeat=3,
+        )
+
+
+def test_run_once_unknown_mode_raises_with_checkpoint(tmp_path):
+    with pytest.raises(ValueError, match="unknown mode"):
+        run_once(
+            Problem(M=10, N=10),
+            mode="bogus",
+            checkpoint_dir=str(tmp_path / "ck"),
+        )
