@@ -60,6 +60,26 @@ def bench_grid(M: int, N: int, oracle: int):
     return report.t_solver, ok
 
 
+def bench_f64_row(grid: tuple[int, int] = HEADLINE, oracle: int = 989) -> bool:
+    """The f64 fidelity row: the reference is entirely double precision
+    (SURVEY §7 names TPU f64 the single biggest fidelity risk), so the
+    bench proves the emulated-f64 path converges in exactly the published
+    iteration count at the headline grid. One plain repetition — this row
+    is a correctness gate, not the timed headline."""
+    M, N = grid
+    report = run_once(
+        Problem(M=M, N=N), mode="single", dtype="f64", engine="auto"
+    )
+    ok = report.converged and report.iters == oracle
+    print(
+        f"  {M}x{N} f64: T_solver={report.t_solver:.4f}s "
+        f"iters={report.iters} (oracle {oracle}) converged={report.converged} "
+        f"engine={report.engine} l2_err={report.l2_error:.3e}",
+        file=sys.stderr,
+    )
+    return ok
+
+
 def main() -> int:
     print(f"devices: {jax.devices()}", file=sys.stderr)
     headline_t, baseline, all_ok = None, None, True
@@ -73,6 +93,9 @@ def main() -> int:
             )
         if (M, N) == HEADLINE:
             headline_t, baseline = t, ref_t
+    # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
+    # which must not perturb the timed f32 rows above
+    all_ok &= bench_f64_row()
     print(
         json.dumps(
             {

@@ -38,6 +38,40 @@ def _parse_grids(args) -> list[tuple[int, int]]:
     return [(40, 40)]
 
 
+def _run_threads_sweep(
+    problem: Problem, counts: list[int], repeat: int, as_json: bool
+) -> int:
+    """The stage1 in-run OpenMP sweep: one native solve per thread count,
+    reported as the reference's table 2 (threads / iters / T / speedup vs
+    the sweep's first count; ``stage1-openmp/Withopenmp1.cpp:205-229``
+    loops ``omp_set_num_threads(t)`` around the same solve)."""
+    if not counts:
+        raise ValueError("--threads-sweep needs at least one thread count")
+    reports = [
+        run_once(problem, mode="native", threads=t, repeat=repeat)
+        for t in counts
+    ]
+    base = reports[0].t_solver
+    if as_json:
+        for rep in reports:
+            rec = rep.json_dict()
+            rec["speedup_vs_first"] = round(base / rep.t_solver, 3)
+            print(json.dumps(rec))
+    else:
+        print(
+            f"Threads sweep {problem.M}x{problem.N} (native f64, "
+            f"delta={problem.delta:g}):"
+        )
+        print("  threads    iters    T_solver(s)   speedup")
+        for t, rep in zip(counts, reports):
+            print(
+                f"  {t:7d}  {rep.iters:7d}  {rep.t_solver:12.4f}  "
+                f"{base / rep.t_solver:8.2f}"
+            )
+        print()
+    return 0 if all(r.converged for r in reports) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.harness",
@@ -67,6 +101,12 @@ def main(argv=None) -> int:
         type=int,
         default=0,
         help="OpenMP thread count for --mode native (0 = default)",
+    )
+    ap.add_argument(
+        "--threads-sweep",
+        help="comma list of OpenMP thread counts to sweep with --mode "
+        "native, printing the stage1 table (T per count + speedup vs the "
+        "first count; Этап1.pdf table 2's in-run sweep)",
     )
     ap.add_argument(
         "--mesh",
@@ -130,6 +170,29 @@ def main(argv=None) -> int:
         else [args.eps]
     )
 
+    if args.threads_sweep:
+        if args.mode != "native":
+            print(
+                "error: --threads-sweep is the OpenMP runtime's in-run "
+                "sweep; it requires --mode native",
+                file=sys.stderr,
+            )
+            return 2
+        if args.threads:
+            print(
+                "error: --threads conflicts with --threads-sweep (the "
+                "sweep list is the thread counts)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.checkpoint_dir:
+            print(
+                "error: checkpointing covers the JAX paths, not native "
+                "runs; drop --checkpoint-dir or --threads-sweep",
+                file=sys.stderr,
+            )
+            return 2
+
     grids = _parse_grids(args)
     # a sweep re-fingerprints the checkpoint each run, so a shared directory
     # would refuse every run after the first — key per-run subdirectories
@@ -154,6 +217,21 @@ def main(argv=None) -> int:
                 norm=args.norm,
                 max_iter=args.max_iter,
             )
+            if args.threads_sweep:
+                try:
+                    rc = max(
+                        rc,
+                        _run_threads_sweep(
+                            problem,
+                            [int(t) for t in args.threads_sweep.split(",")],
+                            repeat=args.repeat,
+                            as_json=args.json,
+                        ),
+                    )
+                except (ValueError, NativeBuildError) as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 2
+                continue
             try:
                 import jax
 

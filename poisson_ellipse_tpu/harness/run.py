@@ -86,6 +86,13 @@ class RunReport:
     passes_per_iter: float = 0.0
     hbm_gbps: float = 0.0
     hbm_peak_frac: float | None = None
+    # OpenMP thread count of a native run (0 = runtime default; the
+    # stage1 sweep tables key on this — Этап1.pdf table 2)
+    threads: int = 0
+    # iterations covered by t_solver when it differs from ``iters`` — a
+    # resumed checkpointed run times only the iterations it ran, while
+    # ``iters`` stays the solver's cumulative (oracle-checked) count
+    timed_iters: int | None = None
 
     def summary(self) -> str:
         p = self.problem
@@ -122,8 +129,9 @@ class RunReport:
 
     def roofline_line(self) -> str:
         """One-line roofline summary, '' when the model does not apply
-        (native host runs, zero iterations)."""
-        if not self.iters or self.engine == "native":
+        (native host runs, zero timed iterations)."""
+        n = self.timed_iters if self.timed_iters is not None else self.iters
+        if not n or self.engine == "native":
             return ""
         frac = (
             f"  ({self.hbm_peak_frac:.1%} of HBM peak)"
@@ -131,7 +139,7 @@ class RunReport:
             else ""
         )
         return (
-            f"Roofline: {self.t_solver / self.iters * 1e6:.1f} us/iter, "
+            f"Roofline: {self.t_solver / n * 1e6:.1f} us/iter, "
             f"{self.passes_per_iter:g} HBM passes/iter -> "
             f"{self.hbm_gbps:.0f} GB/s{frac}"
         )
@@ -155,6 +163,7 @@ class RunReport:
             "passes_per_iter": self.passes_per_iter,
             "hbm_gbps": self.hbm_gbps,
             "hbm_peak_frac": self.hbm_peak_frac,
+            **({"threads": self.threads} if self.engine == "native" else {}),
         }
 
 
@@ -277,33 +286,8 @@ def run_once(
             times.append((time.perf_counter() - t0) / batch)
     timer.add("solver", statistics.median(times))
 
-    with timer.phase("finalize"):
-        l2 = float(l2_error_vs_analytic(problem, result.w))
-
-    from poisson_ellipse_tpu.harness.roofline import roofline
-
-    roof = roofline(
-        problem,
-        engine,
-        int(result.iters),
-        timer.totals["solver"],
-        jdtype,
-        n_devices=shape[0] * shape[1],
-    )
-    return RunReport(
-        problem=problem,
-        mesh_shape=shape,
-        dtype=dtype,
-        engine=engine,
-        iters=int(result.iters),
-        converged=bool(result.converged),
-        breakdown=bool(result.breakdown),
-        diff=float(result.diff),
-        l2_error=l2,
-        t_init=timer.totals["init"],
-        t_solver=timer.totals["solver"],
-        times=times,
-        **roof,
+    return _finish_report(
+        problem, shape, dtype, jdtype, engine, result, timer, times
     )
 
 
@@ -331,6 +315,56 @@ def _chain_solver(solver, args, n: int):
         return solver(*a[:-1], r0 * (1.0 + tiny * acc))
 
     return jax.jit(chained)
+
+
+def _finish_report(
+    problem: Problem,
+    shape: tuple[int, int],
+    dtype: str,
+    jdtype,
+    engine: str,
+    result,
+    timer: PhaseTimer,
+    times: list[float],
+    timed_iters: int | None = None,
+) -> RunReport:
+    """Shared report tail: L2-vs-analytic, roofline, RunReport assembly.
+
+    timed_iters — iterations the solver phase actually covered when that
+    differs from the cumulative count (resumed checkpointed runs); the
+    roofline is computed over it, and it is suppressed entirely for a
+    resume that had nothing left to run.
+    """
+    with timer.phase("finalize"):
+        l2 = float(l2_error_vs_analytic(problem, result.w))
+
+    from poisson_ellipse_tpu.harness.roofline import roofline
+
+    n = timed_iters if timed_iters is not None else int(result.iters)
+    roof = (
+        roofline(
+            problem, engine, n, timer.totals["solver"], jdtype,
+            n_devices=shape[0] * shape[1],
+        )
+        if n > 0
+        else {"passes_per_iter": 0.0, "hbm_gbps": 0.0, "hbm_peak_frac": None}
+    )
+    return RunReport(
+        problem=problem,
+        mesh_shape=shape,
+        dtype=dtype,
+        engine=engine,
+        iters=int(result.iters),
+        converged=bool(result.converged),
+        breakdown=bool(result.breakdown),
+        diff=float(result.diff),
+        l2_error=l2,
+        t_init=timer.totals["init"],
+        t_solver=timer.totals["solver"],
+        times=times,
+        timed_iters=timed_iters,
+        **roof,
+    )
 
 
 def _run_checkpointed(
@@ -368,34 +402,18 @@ def _run_checkpointed(
         (mesh.shape[AXIS_X], mesh.shape[AXIS_Y]) if mesh is not None else (1, 1)
     )
     with solver:
+        # a resume timed from iteration start_k covers only the remaining
+        # iterations — the roofline must not divide resumed wall-clock by
+        # the cumulative count
+        start_k = solver.latest_step() or 0
         t0 = time.perf_counter()
         result = solver.run()
         fence(result)
         t_solve = time.perf_counter() - t0
     timer.add("solver", t_solve)
-    with timer.phase("finalize"):
-        l2 = float(l2_error_vs_analytic(problem, result.w))
-
-    from poisson_ellipse_tpu.harness.roofline import roofline
-
-    roof = roofline(
-        problem, engine, int(result.iters), t_solve, jdtype,
-        n_devices=shape[0] * shape[1],
-    )
-    return RunReport(
-        problem=problem,
-        mesh_shape=shape,
-        dtype=dtype,
-        engine=engine,
-        iters=int(result.iters),
-        converged=bool(result.converged),
-        breakdown=bool(result.breakdown),
-        diff=float(result.diff),
-        l2_error=l2,
-        t_init=timer.totals["init"],
-        t_solver=t_solve,
-        times=[t_solve],
-        **roof,
+    return _finish_report(
+        problem, shape, dtype, jdtype, engine, result, timer, [t_solve],
+        timed_iters=int(result.iters) - start_k,
     )
 
 
@@ -424,4 +442,5 @@ def _run_native(problem: Problem, repeat: int, threads: int) -> RunReport:
         t_init=0.0,
         t_solver=statistics.median(times),
         times=times,
+        threads=threads,
     )

@@ -188,3 +188,71 @@ def test_run_once_unknown_mode_raises_with_checkpoint(tmp_path):
             mode="bogus",
             checkpoint_dir=str(tmp_path / "ck"),
         )
+
+
+def test_cli_threads_sweep(capsys):
+    from poisson_ellipse_tpu.runtime import native_available
+
+    if not native_available():
+        pytest.skip("C++ runtime unavailable")
+    rc = cli_main(
+        ["40", "40", "--mode", "native", "--threads-sweep", "1,2", "--json"]
+    )
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    recs = [json.loads(l) for l in lines]
+    # the stage1 invariant: iteration count is thread-invariant
+    assert [r["iters"] for r in recs] == [50, 50]
+    assert [r["threads"] for r in recs] == [1, 2]
+    assert recs[0]["speedup_vs_first"] == 1.0
+
+
+def test_cli_threads_sweep_requires_native_mode(capsys):
+    rc = cli_main(["40", "40", "--mode", "single", "--threads-sweep", "1,2"])
+    assert rc == 2
+    assert "requires --mode native" in capsys.readouterr().err
+
+
+def test_bench_f64_row_oracle():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench.bench_f64_row(grid=(40, 40), oracle=50) is True
+    assert bench.bench_f64_row(grid=(40, 40), oracle=999) is False
+
+
+def test_cli_threads_sweep_conflicting_flags(capsys):
+    rc = cli_main(
+        ["40", "40", "--mode", "native", "--threads-sweep", "1,2",
+         "--threads", "8"]
+    )
+    assert rc == 2 and "--threads conflicts" in capsys.readouterr().err
+    rc = cli_main(
+        ["40", "40", "--mode", "native", "--threads-sweep", "1,2",
+         "--checkpoint-dir", "ck"]
+    )
+    assert rc == 2 and "not native" in capsys.readouterr().err
+
+
+def test_resumed_checkpoint_report_suppresses_roofline(tmp_path):
+    ck = str(tmp_path / "ck")
+    first = run_once(
+        Problem(M=20, N=20), mode="single", dtype="f64",
+        checkpoint_dir=ck, chunk=7,
+    )
+    assert first.timed_iters == first.iters == 26
+    assert first.roofline_line() != ""
+    # resume of a finished run: zero iterations timed -> no roofline
+    again = run_once(
+        Problem(M=20, N=20), mode="single", dtype="f64",
+        checkpoint_dir=ck, chunk=7,
+    )
+    assert again.iters == 26 and again.timed_iters == 0
+    assert again.roofline_line() == ""
+    assert again.hbm_gbps == 0.0 and again.passes_per_iter == 0.0
