@@ -256,3 +256,16 @@ def test_resumed_checkpoint_report_suppresses_roofline(tmp_path):
     assert again.iters == 26 and again.timed_iters == 0
     assert again.roofline_line() == ""
     assert again.hbm_gbps == 0.0 and again.passes_per_iter == 0.0
+
+
+def test_roofline_line_vmem_resident_wording():
+    from poisson_ellipse_tpu.harness.run import RunReport
+
+    rep = RunReport(
+        problem=Problem(M=40, N=40), mesh_shape=(1, 1), dtype="f32",
+        engine="resident", iters=50, converged=True, breakdown=False,
+        diff=1e-7, l2_error=1e-3, t_init=0.1, t_solver=0.001,
+        passes_per_iter=0.0, hbm_gbps=0.0, hbm_peak_frac=0.0,
+    )
+    line = rep.roofline_line()
+    assert "VMEM-resident" in line and "0 GB/s" not in line
