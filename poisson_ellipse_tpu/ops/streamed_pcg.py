@@ -71,12 +71,38 @@ def _round_up(n: int, m: int) -> int:
 
 
 class StreamPlan:
-    """Which operands stay VMEM-resident, plus the tiling."""
+    """Which operands stay VMEM-resident, plus the tiling.
 
-    def __init__(self, problem: Problem, dtype):
+    tm — row-tile height override (multiple of 8). Default (None) picks
+    128 when that keeps the same operand-residency set as 64, else 64:
+    larger tiles cut per-tile loop/DMA bookkeeping (measured ~12% per
+    iteration at 1600x2400 all-resident) but eat VMEM that the greedy
+    residency pass and Mosaic temporaries want; 256 was measured slower
+    (it demotes an operand to streamed).
+    """
+
+    def __init__(self, problem: Problem, dtype, tm: int | None = None):
+        if tm is None:
+            self._compute(problem, dtype, 64)
+            fits64, res64 = self.fits, sum(self.resident.values())
+            state64 = dict(self.__dict__)
+            self._compute(problem, dtype, 128)
+            if not (
+                self.fits
+                and (not fits64 or sum(self.resident.values()) >= res64)
+            ):
+                self.__dict__.update(state64)
+        else:
+            if tm % 8 or tm < 8:
+                raise ValueError(
+                    f"tm must be a positive multiple of 8, got {tm}"
+                )
+            self._compute(problem, dtype, tm)
+
+    def _compute(self, problem: Problem, dtype, tm: int) -> None:
         g1, g2 = problem.node_shape
         self.g2p = _round_up(g2, 128)
-        self.tm = 64 if g1 >= 64 else _round_up(g1, 8)
+        self.tm = tm if g1 >= tm else _round_up(g1, 8)
         self.g1p = _round_up(g1, self.tm)
         self.n_tiles = self.g1p // self.tm
         item = jnp.dtype(dtype).itemsize
@@ -367,11 +393,12 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
 
 
 def build_streamed_solver(problem: Problem, dtype=jnp.float32,
-                          interpret=None):
+                          interpret=None, tm: int | None = None):
     """(jitted whole-solve kernel, args) for large grids.
 
     args = (dinv, a, b, r0), all f64-assembled and rounded once (same
     operand fidelity as ``fused_pcg.build_fused_solver``).
+    tm — row-tile height (see StreamPlan).
     """
     import numpy as np
 
@@ -380,7 +407,7 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
     if interpret is None:
         interpret = _interpret_default()
     g1, g2 = problem.node_shape
-    plan = StreamPlan(problem, dtype)
+    plan = StreamPlan(problem, dtype, tm=tm)
     if not plan.fits:
         raise ValueError(
             f"grid {problem.M}x{problem.N}: PCG state (w, r, p) alone "

@@ -124,15 +124,18 @@ def test_streamed_forced_all_streaming_parity(monkeypatch):
 
     problem = Problem(M=200, N=132, norm="weighted")
     ref = solve_xla(problem, jnp.float32)
-    base_plan = StreamPlan(problem, jnp.float32)
+    # pin tm=64: the budget arithmetic below assumes one tile size (the
+    # auto policy would otherwise re-spend the forced budget on tm=128)
+    base_plan = StreamPlan(problem, jnp.float32, tm=64)
     state_bytes = (3 * base_plan.g1p + 16) * base_plan.g2p * 4
     monkeypatch.setattr(
         sp, "_VMEM_USABLE", state_bytes + base_plan.min_stream_bytes
     )
-    plan = sp.StreamPlan(problem, jnp.float32)
+    plan = sp.StreamPlan(problem, jnp.float32, tm=64)
     assert plan.fits and not any(plan.resident.values())
     assert plan.n_tiles >= 3  # exercises even/odd slots + tail drain
-    got = sp.solve_streamed(problem, jnp.float32)
+    solver, args = sp.build_streamed_solver(problem, jnp.float32, tm=64)
+    got = solver(*args)
     assert int(got.iters) == int(ref.iters)
     assert bool(got.converged)
     np.testing.assert_allclose(
@@ -150,6 +153,21 @@ def test_stream_plan_shapes():
     # resident state is excluded from the dict
     assert set(plan.resident) == {"dinv", "ap", "a", "b"}
     assert plan.streamed_passes_per_iter() >= 0.0
+
+
+def test_stream_plan_auto_tile_policy():
+    # all-resident at both tile sizes -> auto takes the bigger tile
+    p_mid = Problem(M=1600, N=2400)
+    assert StreamPlan(p_mid, jnp.float32).tm == 128
+    assert StreamPlan(p_mid, jnp.float32, tm=64).tm == 64
+    # auto never trades residency for tile size: whatever it picks keeps
+    # at least as many operands resident as tm=64 would
+    for M, N in ((1600, 2400), (2000, 2800), (2400, 3200)):
+        plan = StreamPlan(Problem(M=M, N=N), jnp.float32)
+        plan64 = StreamPlan(Problem(M=M, N=N), jnp.float32, tm=64)
+        assert sum(plan.resident.values()) >= sum(plan64.resident.values())
+    with pytest.raises(ValueError, match="multiple of 8"):
+        StreamPlan(p_mid, jnp.float32, tm=100)
 
 
 # ---------------------------------------------------------------- policy
