@@ -305,6 +305,17 @@ def normalized_coefficients(problem: Problem, a, b, g1p: int, g2p: int,
     )
 
 
+def normalized_unmasked(problem: Problem, a, b):
+    """(an, bw) = (a/h1², b/h2²) over the full grid, unmasked, in the
+    input precision — the one place the 1/h² hoisting algebra lives.
+    ``interior_normalized`` builds the masked operand set from these; the
+    streamed engine uses them directly (its south/east coefficients are
+    offset slices, which only works unmasked)."""
+    ih1 = 1.0 / (float(problem.h1) * float(problem.h1))
+    ih2 = 1.0 / (float(problem.h2) * float(problem.h2))
+    return a * ih1, b * ih2
+
+
 def interior_normalized(problem: Problem, a, b):
     """(an, as_, bw, be, d, dinv) in the *input* precision, unpadded.
 
@@ -316,11 +327,8 @@ def interior_normalized(problem: Problem, a, b):
 
     xp = np if isinstance(a, np.ndarray) else jnp
     g1, g2 = a.shape
-    ih1 = 1.0 / (float(problem.h1) * float(problem.h1))
-    ih2 = 1.0 / (float(problem.h2) * float(problem.h2))
-    an = a * ih1
+    an, bw = normalized_unmasked(problem, a, b)
     as_ = xp.roll(an, -1, axis=0)
-    bw = b * ih2
     be = xp.roll(bw, -1, axis=1)
     gi = xp.arange(g1)[:, None]
     gj = xp.arange(g2)[None, :]
