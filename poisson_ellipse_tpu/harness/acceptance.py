@@ -1,0 +1,105 @@
+"""On-accelerator acceptance gate: every engine compiles and hits the oracles.
+
+``python -m poisson_ellipse_tpu.harness.acceptance`` runs each solver
+engine on the small reference grids and asserts the published weighted
+iteration counts (15/26/50 @ 10²/20²/40², from the compiled reference
+stage1 code), plus the sharded path over whatever device mesh exists.
+On a TPU this is the real-compile gate the CPU test suite cannot be
+(tests/conftest.py pins the CPU backend; the Pallas engines interpret
+there) — run it on the chip to prove the Mosaic kernels still build and
+agree with the reference before trusting a bench number. The reference
+has no automated tests at all (SURVEY §4); its manual oracle — identical
+iteration counts across implementations (Этап1-4 tables) — is exactly
+what this gate automates across *engines*.
+
+``--headline`` adds the 400×600 row (546 iterations) with the auto
+engine. Exit code 0 iff every row passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.solver.engine import ENGINES, build_solver
+
+# (M, N) -> weighted-norm oracle iterations (reference stage1 code,
+# compiled and run; see BASELINE.md "Iteration counts")
+SMALL_ORACLES = {(10, 10): 15, (20, 20): 26, (40, 40): 50}
+HEADLINE = ((400, 600), 546)
+
+
+def _row(engine: str, M: int, N: int, oracle: int) -> tuple[bool, str]:
+    problem = Problem(M=M, N=N)
+    try:
+        solver, args, resolved = build_solver(
+            problem, engine, jnp.float32
+        )
+        result = solver(*args)
+        iters = int(result.iters)
+        ok = bool(result.converged) and iters == oracle
+        note = f"iters={iters} (oracle {oracle})"
+        if resolved != engine:
+            note += f" [auto->{resolved}]"
+    except Exception as e:  # a build/compile failure IS the finding
+        ok, note = False, f"{type(e).__name__}: {e}"
+    return ok, note
+
+
+def _sharded_row(M: int, N: int, oracle: int) -> tuple[bool, str]:
+    from poisson_ellipse_tpu.parallel.pcg_sharded import solve_sharded
+
+    try:
+        result = solve_sharded(Problem(M=M, N=N), dtype=jnp.float32)
+        iters = int(result.iters)
+        ok = bool(result.converged) and iters == oracle
+        note = f"iters={iters} (oracle {oracle}) over {len(jax.devices())} device(s)"
+    except Exception as e:
+        ok, note = False, f"{type(e).__name__}: {e}"
+    return ok, note
+
+
+def run_acceptance(headline: bool = False, out=sys.stderr) -> bool:
+    print(f"backend: {jax.default_backend()}  devices: {jax.devices()}",
+          file=out)
+    all_ok = True
+    engines = [e for e in ENGINES if e != "auto"]
+    for (M, N), oracle in SMALL_ORACLES.items():
+        for engine in engines:
+            ok, note = _row(engine, M, N, oracle)
+            all_ok &= ok
+            print(f"  {'ok ' if ok else 'FAIL'} {M}x{N} {engine:9s} {note}",
+                  file=out)
+    for (M, N), oracle in list(SMALL_ORACLES.items())[-1:]:
+        ok, note = _sharded_row(M, N, oracle)
+        all_ok &= ok
+        print(f"  {'ok ' if ok else 'FAIL'} {M}x{N} {'sharded':9s} {note}",
+              file=out)
+    if headline:
+        (M, N), oracle = HEADLINE
+        ok, note = _row("auto", M, N, oracle)
+        all_ok &= ok
+        print(f"  {'ok ' if ok else 'FAIL'} {M}x{N} {'auto':9s} {note}",
+              file=out)
+    print("ACCEPTANCE " + ("PASS" if all_ok else "FAIL"), file=out)
+    return all_ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness.acceptance"
+    )
+    ap.add_argument(
+        "--headline", action="store_true",
+        help="also run 400x600 (546-iteration oracle) with the auto engine",
+    )
+    args = ap.parse_args(argv)
+    return 0 if run_acceptance(headline=args.headline) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,16 @@ else streamed if the state fits; else xla. f64 always takes xla — the
 Pallas engines are f32/bf16 (TPU f64 is emulated, and the XLA path is the
 only one with an f64 story). ``fused`` never wins outright on the bench
 chip so auto never picks it, but it remains selectable for comparison.
+
+Past the streamed gate (~2400x3200 f32; e.g. the 4096² north-star grid,
+whose w/r/p state alone is ~200 MB) xla is the *right* engine, not a
+compromise: with no state resident a custom kernel still needs two
+sweeps per iteration (PCG has two scalar sync points) costing ~14 HBM
+array-passes vs the ~13 the XLA while_loop streams, and the measured XLA
+path already runs at ~3/4 of HBM peak there — single-chip solves at that
+size are bandwidth-bound, and the framework's scaling answer is the
+sharded mesh path (``parallel.pcg_sharded``), which divides the state
+over devices until it is VMEM-resident again.
 """
 
 from __future__ import annotations
