@@ -11,14 +11,14 @@ twice — operands in at entry, solution out at exit.
 
 This is the design point the chip's memory system rewards: the bench
 part has ~128 MB of VMEM (measured; ``vmem_limit_bytes`` raised
-accordingly), so grids whose ~17-array working set fits the 100 MB
-residency budget — everything up to roughly 900x1300, which covers the
+accordingly), so grids whose ~17-array working set fits the 125 MB
+residency budget — everything up to roughly 1100x1650, which covers the
 reference's 400x600 and 800x1200 headline grids (``fits_resident`` is
 the exact gate) — run the whole solve on-chip, where iteration cost is
-pure VPU arithmetic
-(~2-8 us/iter) instead of the ~40-75 us/iter the kernel-per-op
-structure costs. Grids that don't fit fall back to the streaming fused
-path (``ops.fused_pcg``) — use ``fits_resident`` to pick.
+pure VPU arithmetic (measured 3.5 us/iter @ 400x600, 7.9 @ 800x1200,
+14.5 @ 1100x1650) instead of the ~40-75 us/iter the kernel-per-op
+structure costs. Grids that don't fit fall back to the streamed
+whole-solve kernel (``ops.streamed_pcg``) — ``solver.engine`` picks.
 
 Arithmetic is the normalised-stencil form shared with ``fused_pcg``
 (coefficients pre-divided by h^2 and pre-masked to the interior; the
@@ -46,11 +46,14 @@ from poisson_ellipse_tpu.ops.fused_pcg import fused_operands
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
 
 # Measured usable VMEM on the bench part (128 MiB minus compiler
-# reserves); the resident gate keeps a wide margin for Mosaic temps.
+# reserves).
 _VMEM_LIMIT = 127 * 1024 * 1024
-_RESIDENT_BUDGET = 100 * 1024 * 1024
-# operand arrays (6 coeffs + rhs) + while-carry state (w, r, p; double
-# buffered) + ~4 live temporaries during the stencil/update expressions
+_RESIDENT_BUDGET = 125 * 1024 * 1024
+# Empirical working-set envelope: operands (6 coeffs + rhs) + scratch
+# state (w, r, p) + w_out + ~6 Mosaic temporaries during the whole-array
+# stencil/update expressions. Chip-measured with the scratch-state
+# kernel: 1100x1650 (17 arrays = 124.9 MB) compiles and converges;
+# 1200x1800 (157.7 MB) fails Mosaic allocation — hence BUDGET=125 MB.
 _ARRAYS_RESIDENT = 17
 
 
@@ -97,8 +100,19 @@ def _shift_cols_left(x):
 
 def _mega_kernel(h1, h2, delta, weighted, max_iter,
                  an, as_, bw, be, d, dinv, r0,
-                 w_out, iters_out, diff_out, flags_out):
-    """The full PCG solve. Runs as a single grid-less invocation."""
+                 w_out, iters_out, diff_out, flags_out,
+                 w_s, r_s, p_s):
+    """The full PCG solve. Runs as a single grid-less invocation.
+
+    State (w, r, p) lives in mutable VMEM scratch and the while_loop
+    carries only scalars: carrying arrays would make Mosaic double-buffer
+    them (an extra full-array copy each per iteration and ~3 more
+    resident arrays of budget). In-place updates are value-safe on the
+    breakdown path because alpha is forced to 0 there — w + 0·p and
+    r − 0·ap are bitwise w and r, the reference's exit-before-touching
+    semantics (``stage0/Withoutopenmp1.cpp:128``); p is rotated-loop
+    state and is never read after exit.
+    """
     dtype = r0.dtype
     an_v = an[...]
     as_v = as_[...]
@@ -112,12 +126,12 @@ def _mega_kernel(h1, h2, delta, weighted, max_iter,
     z0 = r_init * dinv_v
     zr0 = jnp.sum(z0 * r_init) * h1h2
 
-    zero_grid = jnp.zeros_like(r_init)
+    w_s[...] = jnp.zeros_like(r_init)
+    r_s[...] = r_init
+    p_s[...] = jnp.zeros_like(r_init)   # beta0 = 0 -> p1 = z0
+
     carry0 = (
         jnp.asarray(0, jnp.int32),
-        zero_grid,                     # w
-        r_init,                        # r
-        zero_grid,                     # p  (beta0 = 0 -> p1 = z0)
         zr0,
         jnp.asarray(0.0, dtype),       # beta
         jnp.asarray(jnp.inf, dtype),   # diff
@@ -126,12 +140,13 @@ def _mega_kernel(h1, h2, delta, weighted, max_iter,
     )
 
     def cond(c):
-        k, _w, _r, _p, _zr, _b, _d, conv, bd = c
+        k, _zr, _b, _d, conv, bd = c
         return (k < max_iter) & ~conv & ~bd
 
     def body(c):
-        k, w, r, p, zr, beta, diff, _cv, _bd = c
-        pn = r * dinv_v + beta * p
+        k, zr, beta, diff, _cv, _bd = c
+        pn = r_s[...] * dinv_v + beta * p_s[...]
+        p_s[...] = pn
         ap = d_v * pn - (
             an_v * _shift_rows_down(pn)
             + as_v * _shift_rows_up(pn)
@@ -143,8 +158,11 @@ def _mega_kernel(h1, h2, delta, weighted, max_iter,
         alpha = zr / jnp.where(breakdown, jnp.ones_like(denom), denom)
         alpha = jnp.where(breakdown, jnp.zeros_like(alpha), alpha)
 
+        w = w_s[...]
         w_new = w + alpha * pn
-        r_new = r - alpha * ap
+        r_new = r_s[...] - alpha * ap
+        w_s[...] = w_new
+        r_s[...] = r_new
         # realised increment (w_new - w), not alpha*p: the convergence
         # oracle counts depend on the FP difference (cu:626-660)
         dw = w_new - w
@@ -156,15 +174,14 @@ def _mega_kernel(h1, h2, delta, weighted, max_iter,
         ndiff = jnp.where(breakdown, diff, ndiff)
         beta_new = jnp.where(breakdown, beta, zr_new / zr)
         zr_out = jnp.where(breakdown, zr, zr_new)
-        return (k + 1, w_new, r_new, pn, zr_out, beta_new, ndiff,
-                conv, breakdown)
+        return (k + 1, zr_out, beta_new, ndiff, conv, breakdown)
 
     out = lax.while_loop(cond, body, carry0)
-    w_out[...] = out[1]
+    w_out[...] = w_s[...]
     iters_out[0] = out[0]
-    diff_out[0] = out[6]
-    flags_out[0] = out[7].astype(jnp.int32)
-    flags_out[1] = out[8].astype(jnp.int32)
+    diff_out[0] = out[3]
+    flags_out[0] = out[4].astype(jnp.int32)
+    flags_out[1] = out[5].astype(jnp.int32)
 
 
 def build_resident_solver(problem: Problem, dtype=jnp.float32,
@@ -182,7 +199,8 @@ def build_resident_solver(problem: Problem, dtype=jnp.float32,
     if not fits_resident(problem, dtype):
         raise ValueError(
             f"grid {problem.M}x{problem.N} exceeds the VMEM-resident "
-            "budget; use the fused streaming path"
+            "budget; use the streamed engine (ops.streamed_pcg) or let "
+            "solver.engine pick"
         )
     if interpret is None:
         interpret = _interpret_default()
@@ -214,6 +232,11 @@ def build_resident_solver(problem: Problem, dtype=jnp.float32,
             jax.ShapeDtypeStruct((1,), dtype),
             jax.ShapeDtypeStruct((2,), jnp.int32),
         ),
+        scratch_shapes=[
+            pltpu.VMEM((g1p, g2p), dtype),  # w
+            pltpu.VMEM((g1p, g2p), dtype),  # r
+            pltpu.VMEM((g1p, g2p), dtype),  # p
+        ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT
         ),

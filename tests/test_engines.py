@@ -278,3 +278,12 @@ def test_run_once_carries_roofline():
     rec = report.json_dict()
     assert {"passes_per_iter", "hbm_gbps", "hbm_peak_frac"} <= set(rec)
     assert "Roofline:" in report.summary()
+
+
+def test_fits_resident_measured_edge():
+    # chip-measured envelope (resident_pcg._ARRAYS_RESIDENT comment):
+    # 1100x1650 compiles and solves on the bench part; 1200x1800 does not
+    assert fits_resident(Problem(M=1100, N=1650))
+    assert not fits_resident(Problem(M=1200, N=1800))
+    assert select_engine(Problem(M=1100, N=1650)) == "resident"
+    assert select_engine(Problem(M=1200, N=1800)) == "streamed"
