@@ -70,9 +70,32 @@ def build_solver(
     All engines share the PCGResult contract and the f64-host-assembled,
     rounded-once operand fidelity, so swapping engines changes speed, not
     iteration counts (verified against the published oracles).
+
+    "auto" degrades gracefully: the capacity gates are budgets measured
+    on the bench part, so on a chip with a different VMEM size a selected
+    Pallas engine could fail Mosaic compilation — auto AOT-compiles the
+    pick and falls down the chain (resident → streamed → xla; xla cannot
+    fail this way) instead of surfacing an opaque compile error.
+    Explicitly requested engines still fail loudly.
     """
     if engine == "auto":
-        engine = select_engine(problem, dtype)
+        import jax
+
+        chain = ("resident", "streamed", "xla")
+        chain = chain[chain.index(select_engine(problem, dtype)):]
+        last_err = None
+        for cand in chain:
+            try:
+                solver, args, _ = build_solver(
+                    problem, cand, dtype, interpret
+                )
+                if cand != "xla" and jax.default_backend() == "tpu":
+                    # force Mosaic compilation now, where we can catch it
+                    solver.lower(*args).compile()
+                return solver, args, cand
+            except Exception as e:  # noqa: BLE001 — fall down the chain
+                last_err = e
+        raise last_err  # unreachable: the xla build has no capacity gate
     if engine == "resident":
         from poisson_ellipse_tpu.ops.resident_pcg import build_resident_solver
 

@@ -287,3 +287,23 @@ def test_fits_resident_measured_edge():
     assert not fits_resident(Problem(M=1200, N=1800))
     assert select_engine(Problem(M=1100, N=1650)) == "resident"
     assert select_engine(Problem(M=1200, N=1800)) == "streamed"
+
+
+def test_auto_falls_back_when_selected_engine_fails(monkeypatch):
+    """Capacity gates are bench-chip budgets; on a part where the chosen
+    Pallas engine cannot build, auto must degrade down the chain instead
+    of surfacing the compile error."""
+    import poisson_ellipse_tpu.ops.resident_pcg as rp
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic: RESOURCE_EXHAUSTED (simulated)")
+
+    monkeypatch.setattr(rp, "build_resident_solver", boom)
+    problem = Problem(M=40, N=40)
+    solver, args, engine = build_solver(problem, "auto")
+    assert engine in ("streamed", "xla")  # resident was the selection
+    result = solver(*args)
+    assert int(result.iters) == WEIGHTED_ORACLE[(40, 40)]
+    # explicit requests still fail loudly
+    with pytest.raises(RuntimeError, match="simulated"):
+        build_solver(problem, "resident")
