@@ -298,6 +298,21 @@ def main(argv=None) -> int:
                 print(report.summary())
                 if phases is not None:
                     print(format_phases(phases, report.iters))
+                if (
+                    args.batch == 1
+                    and args.mode != "native"
+                    and args.checkpoint_dir is None
+                ):
+                    import jax
+
+                    if jax.default_backend() != "cpu":
+                        print(
+                            "note: single-dispatch T_solver includes the "
+                            "fixed host<->device round-trip; pass e.g. "
+                            "--repeat 3 --batch 5 for the amortised "
+                            "protocol bench.py uses",
+                            file=sys.stderr,
+                        )
                 print()
             if not report.converged:
                 rc = 1
