@@ -198,6 +198,11 @@ def profile_sharded(
 def format_phases(phases: dict[str, float], iters: int | None = None) -> str:
     lines = ["Per-iteration phase costs (on-device chained replay):"]
     for name, secs in sorted(phases.items(), key=lambda kv: -kv[1]):
+        if secs == 0.0 and name != "halo":
+            # the (t_5k - t_k) subtraction clamps at 0 when the phase
+            # costs less than the dispatch-time noise (tunneled chips)
+            lines.append(f"  t_{name:<12s}      below noise floor")
+            continue
         line = f"  t_{name:<12s} {secs * 1e6:10.1f} us"
         if iters:
             line += f"   (x{iters} iters = {secs * iters:8.4f} s)"
