@@ -300,7 +300,9 @@ def test_auto_falls_back_when_selected_engine_fails(monkeypatch):
 
     monkeypatch.setattr(rp, "build_resident_solver", boom)
     problem = Problem(M=40, N=40)
-    solver, args, engine = build_solver(problem, "auto")
+    # degradation must be loud: the failed engine is named in a warning
+    with pytest.warns(RuntimeWarning, match="'resident' failed"):
+        solver, args, engine = build_solver(problem, "auto")
     assert engine in ("streamed", "xla")  # resident was the selection
     result = solver(*args)
     assert int(result.iters) == WEIGHTED_ORACLE[(40, 40)]
