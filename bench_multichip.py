@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 
 
@@ -51,33 +50,20 @@ def main(argv=None) -> int:
 
     if not args.real:
         # the virtual-device flag and platform pin must land before the
-        # first backend initialisation
-        flags = os.environ.get("XLA_FLAGS", "")
-        n_virtual = args.virtual_devices
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{n_virtual}".strip()
-            )
-        else:
-            # a pre-set count wins (XLA parses the flags once) — say so
-            # instead of claiming the requested number
-            import re
+        # first backend initialisation (the shared helper handles the
+        # ordering and keeps the accelerator backend untouched)
+        from poisson_ellipse_tpu.parallel.mesh import virtual_cpu_devices
 
-            m = re.search(
-                r"xla_force_host_platform_device_count=(\d+)", flags
+        n_virtual = len(virtual_cpu_devices(args.virtual_devices))
+        if n_virtual != args.virtual_devices:
+            # a pre-set XLA_FLAGS count wins (XLA parses the flags once)
+            # — say so instead of claiming the requested number
+            print(
+                f"note: XLA_FLAGS already pins "
+                f"{n_virtual} host devices; --virtual-devices "
+                f"{args.virtual_devices} ignored",
+                file=sys.stderr,
             )
-            n_virtual = int(m.group(1)) if m else n_virtual
-            if n_virtual != args.virtual_devices:
-                print(
-                    f"note: XLA_FLAGS already pins "
-                    f"{n_virtual} host devices; --virtual-devices "
-                    f"{args.virtual_devices} ignored",
-                    file=sys.stderr,
-                )
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
         print(
             f"note: virtual {n_virtual}-device CPU mesh "
             "(scaled-down grids unless --grid given); pass --real on a "

@@ -14,6 +14,7 @@ exterior Dirichlet ring and never influence the interior solve.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import numpy as np
@@ -21,6 +22,34 @@ from jax.sharding import Mesh
 
 AXIS_X = "x"
 AXIS_Y = "y"
+
+
+def virtual_cpu_devices(n: int):
+    """Provision virtual CPU devices without touching the default backend.
+
+    The order-sensitive ritual shared by the driver's multichip dryrun
+    gate and the virtual-mesh benchmarks: XLA parses XLA_FLAGS exactly
+    once, at the first backend initialisation, so the host-device-count
+    flag must be in the environment before any device query; and the
+    environment may pin JAX_PLATFORMS to a hardware plugin — under an
+    explicit pin, backend discovery REQUIRES that plugin to come up, so a
+    sick accelerator runtime would kill even ``jax.devices("cpu")``.
+    Platform discovery is therefore restricted to the CPU client, which
+    is all these paths need. Backend discovery is one-shot per process:
+    after this call the whole process is CPU-only, so callers that need
+    accelerator work afterwards must run this in a separate process.
+
+    Returns the CPU client's device list. If XLA_FLAGS already pins a
+    host-device count, that count wins (XLA reads the flag once);
+    callers needing exactly ``n`` devices must check the length.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip()
+        )
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices("cpu")
 
 
 def choose_process_grid(size: int) -> tuple[int, int]:

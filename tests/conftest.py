@@ -1,26 +1,22 @@
 """Test harness configuration.
 
-Forces the CPU backend with 8 virtual XLA devices *before* jax is imported,
-so the distributed path (mesh / ppermute halos / psum reductions) is
-unit-testable with no TPU — the strategy SURVEY.md §4 prescribes (the
-reference analogously tests small grids at 1/2/4 ranks via mpirun on one
-host). Enables x64 because the reference is entirely double precision and
-the iteration-count oracles are f64 facts.
+Forces the CPU backend with 8 virtual XLA devices before any backend
+initialisation, so the distributed path (mesh / ppermute halos / psum
+reductions) is unit-testable with no TPU — the strategy SURVEY.md §4
+prescribes (the reference analogously tests small grids at 1/2/4 ranks
+via mpirun on one host). Enables x64 because the reference is entirely
+double precision and the iteration-count oracles are f64 facts.
+
+The order-sensitive flag/platform ritual lives in
+``parallel.mesh.virtual_cpu_devices`` — the same helper the driver's
+multichip dryrun gate and the virtual-mesh benchmark use, so the test
+suite exercises the production pinning path rather than a hand-rolled
+copy that could drift.
 """
 
-import os
+import jax
 
-# Note: the environment may pre-import jax (sitecustomize) and pin
-# JAX_PLATFORMS to a hardware plugin, so env vars alone are not enough —
-# XLA_FLAGS is still read lazily at CPU-backend init, and the platform is
-# switched via jax.config below.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from poisson_ellipse_tpu.parallel.mesh import virtual_cpu_devices
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+virtual_cpu_devices(8)
 jax.config.update("jax_enable_x64", True)
