@@ -9,11 +9,23 @@ single-accelerator number on the same grid: stage4 MPI+CUDA, 1 rank /
 Convergence (δ=1e-6, weighted norm) and the iteration-count oracles
 (546 @ 400×600, 989 @ 800×1200, 1858 @ 1600×2400, 2449 @ 2400×3200) are
 checked and reported on stderr; a mismatch marks the run invalid.
+
+Beyond the reference grids, the BASELINE.json target configs also run and
+ride inside the same JSON line (the reference publishes no numbers for
+them, so they carry no vs-ratio — convergence + L2-vs-analytic are the
+checks):
+  config 2    — 1024×1024 single-chip        -> "config2" key
+  north star  — 4096×4096 single-chip        -> "north_star" key
+  config 5    — ε-sweep (1e-2..1e-6) @ 1024² -> "eps_sweep" key, with the
+                fictitious-domain stiffness result asserted: iteration
+                counts stay FLAT as ε shrinks (the Jacobi preconditioner
+                absorbs the 1/ε stiffness — see ``bench_eps_sweep``).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import sys
 
 import jax
@@ -31,6 +43,9 @@ GRIDS = [
 HEADLINE = (800, 1200)
 REPS = 3
 BATCH = 9
+# BASELINE.json config 5: ε-sweep grid + values (largest -> smallest)
+EPS_GRID = (1024, 1024)
+EPS_VALUES = (1e-2, 1e-3, 1e-4, 1e-5, 1e-6)
 
 
 def bench_grid(M: int, N: int, oracle: int):
@@ -80,6 +95,93 @@ def bench_f64_row(grid: tuple[int, int] = HEADLINE, oracle: int = 989) -> bool:
     return ok
 
 
+def bench_baseline_config(M: int, N: int, label: str, amortised: bool):
+    """One BASELINE.json target config (no published reference number:
+    checks are convergence + a finite, small L2-vs-analytic error).
+
+    amortised=False uses plain dispatch timing — at the north-star size a
+    solve takes seconds, so the fixed ~0.16 s tunnel RTT is noise and the
+    chained protocol would multiply a multi-second solve by BATCH."""
+    report = run_once(
+        Problem(M=M, N=N),
+        mode="single",
+        dtype="f32",
+        engine="auto",
+        repeat=REPS if amortised else 2,
+        batch=BATCH if amortised else 1,
+    )
+    ok = report.converged and math.isfinite(report.l2_error) \
+        and report.l2_error < 1e-2
+    print(
+        f"  [{label}] {M}x{N}: T_solver={report.t_solver:.4f}s "
+        f"iters={report.iters} converged={report.converged} "
+        f"engine={report.engine} l2_err={report.l2_error:.3e}  "
+        + report.roofline_line(),
+        file=sys.stderr,
+    )
+    row = {
+        "grid": [M, N],
+        "t_solver_s": round(report.t_solver, 5),
+        "iters": report.iters,
+        "converged": report.converged,
+        "engine": report.engine,
+        "l2_error": report.l2_error,
+    }
+    return row, ok
+
+
+def bench_eps_sweep():
+    """BASELINE.json config 5: the fictitious-domain stiffness study.
+
+    Smaller ε stiffens the raw operator (face coefficients scale as 1/ε
+    outside the ellipse — ``ops/assembly.py``), but the stiff rows are
+    diagonally dominated by the same 1/ε, so the Jacobi-preconditioned
+    system's conditioning is ε-uniform: measured iteration counts are
+    *flat* as ε → 0 (e.g. 315/287/285/285/285 over ε = 1/1e-1/1e-2/1e-4/
+    1e-6 at 256²). That ε-robustness — the solver does not degrade as the
+    fictitious domain hardens — is the study's result, and what the sweep
+    asserts: every run converged and the iteration counts sit in a narrow
+    band (≤ 25% spread) across four decades of ε."""
+    M, N = EPS_GRID
+    rows = []
+    for eps in EPS_VALUES:
+        report = run_once(
+            Problem(M=M, N=N, eps=eps),
+            mode="single",
+            dtype="f32",
+            engine="auto",
+        )
+        print(
+            f"  [eps-sweep] {M}x{N} eps={eps:g}: iters={report.iters} "
+            f"converged={report.converged} engine={report.engine} "
+            f"T_solver={report.t_solver:.4f}s l2_err={report.l2_error:.3e}",
+            file=sys.stderr,
+        )
+        rows.append(
+            {
+                "eps": eps,
+                "iters": report.iters,
+                "converged": report.converged,
+                "t_solver_s": round(report.t_solver, 5),
+                "l2_error": report.l2_error,
+            }
+        )
+    iters = [r["iters"] for r in rows]
+    flat = (max(iters) - min(iters)) <= 0.25 * min(iters)
+    ok = all(r["converged"] for r in rows) and flat
+    print(
+        f"  [eps-sweep] iters {iters} over eps {EPS_VALUES[0]:g} -> "
+        f"{EPS_VALUES[-1]:g}: "
+        + (
+            "flat (eps-robust, preconditioner absorbs the stiffness) — OK"
+            if flat
+            else "TREND VIOLATION (iteration count is eps-sensitive)"
+        ),
+        file=sys.stderr,
+    )
+    return rows, ok
+
+
 def main() -> int:
     print(f"devices: {jax.devices()}", file=sys.stderr)
     headline_t, baseline, all_ok = None, None, True
@@ -93,6 +195,11 @@ def main() -> int:
             )
         if (M, N) == HEADLINE:
             headline_t, baseline = t, ref_t
+    # BASELINE.json target configs (no reference numbers published)
+    config2, ok2 = bench_baseline_config(1024, 1024, "config2", amortised=True)
+    north, okn = bench_baseline_config(4096, 4096, "north-star", amortised=False)
+    eps_rows, oke = bench_eps_sweep()
+    all_ok &= ok2 & okn & oke
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
     all_ok &= bench_f64_row()
@@ -104,6 +211,9 @@ def main() -> int:
                 "unit": "s",
                 "vs_baseline": round(baseline / headline_t, 2),
                 "valid": all_ok,
+                "config2": config2,
+                "north_star": north,
+                "eps_sweep": eps_rows,
             }
         )
     )
