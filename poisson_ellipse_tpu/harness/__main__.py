@@ -94,7 +94,8 @@ def main(argv=None) -> int:
         help="solver engine. Single-device: auto picks the fastest that "
         "fits (resident -> streamed -> xla); fused is the two-kernel "
         "HBM iteration, pallas the per-op stencil kernel. Sharded mode: "
-        "xla (default) or pallas (the per-shard stencil kernel)",
+        "xla (default), pallas (the per-shard stencil kernel), or fused "
+        "(the two-kernel per-shard iteration, f32/bf16)",
     )
     ap.add_argument(
         "--threads",

@@ -50,11 +50,15 @@ def _row(engine: str, M: int, N: int, oracle: int) -> tuple[bool, str]:
     return ok, note
 
 
-def _sharded_row(M: int, N: int, oracle: int) -> tuple[bool, str]:
+def _sharded_row(
+    M: int, N: int, oracle: int, stencil_impl: str = "xla"
+) -> tuple[bool, str]:
     from poisson_ellipse_tpu.parallel.pcg_sharded import solve_sharded
 
     try:
-        result = solve_sharded(Problem(M=M, N=N), dtype=jnp.float32)
+        result = solve_sharded(
+            Problem(M=M, N=N), dtype=jnp.float32, stencil_impl=stencil_impl
+        )
         iters = int(result.iters)
         ok = bool(result.converged) and iters == oracle
         note = f"iters={iters} (oracle {oracle}) over {len(jax.devices())} device(s)"
@@ -75,10 +79,14 @@ def run_acceptance(headline: bool = False, out=sys.stderr) -> bool:
             print(f"  {'ok ' if ok else 'FAIL'} {M}x{N} {engine:9s} {note}",
                   file=out)
     for (M, N), oracle in list(SMALL_ORACLES.items())[-1:]:
-        ok, note = _sharded_row(M, N, oracle)
-        all_ok &= ok
-        print(f"  {'ok ' if ok else 'FAIL'} {M}x{N} {'sharded':9s} {note}",
-              file=out)
+        for impl in ("xla", "pallas", "fused"):
+            ok, note = _sharded_row(M, N, oracle, stencil_impl=impl)
+            all_ok &= ok
+            print(
+                f"  {'ok ' if ok else 'FAIL'} {M}x{N} "
+                f"{'sharded/' + impl:14s} {note}",
+                file=out,
+            )
     if headline:
         (M, N), oracle = HEADLINE
         ok, note = _row("auto", M, N, oracle)

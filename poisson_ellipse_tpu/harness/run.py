@@ -239,11 +239,12 @@ def run_once(
             fence(args)
         shape = (1, 1)
     elif mode == "sharded":
-        if engine not in ("auto", "xla", "pallas"):
+        if engine not in ("auto", "xla", "pallas", "fused"):
             raise ValueError(
                 f"engine {engine!r} is single-device only; sharded mode "
-                "runs the XLA block stencil ('xla', default) or the "
-                "per-shard Pallas stencil kernel ('pallas')"
+                "runs the XLA block stencil ('xla', default), the "
+                "per-shard Pallas stencil kernel ('pallas'), or the "
+                "two-kernel fused per-shard iteration ('fused', f32/bf16)"
             )
         engine = "xla" if engine == "auto" else engine
         with timer.phase("init"):

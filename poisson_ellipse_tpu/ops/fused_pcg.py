@@ -354,6 +354,56 @@ def fused_operands(problem: Problem, g1p: int, g2p: int, dtype):
     return normalized_coefficients(problem, a64, b64, g1p, g2p, np_dtype)
 
 
+def rotated_state0(w0, r0, z0, p0, zr0, dtype):
+    """Iteration-0 carry of the rotated fused loop — the one layout
+    (k, w, r, z, p, zr, beta, diff, converged, breakdown) shared by the
+    single-chip engine and ``parallel.fused_sharded`` (beta0 = 0 makes
+    the first K1 produce p1 = z0, the reference's initial direction)."""
+    return (
+        jnp.asarray(0, jnp.int32),
+        w0,
+        r0,
+        z0,
+        p0,
+        zr0,
+        jnp.asarray(0.0, dtype),        # beta
+        jnp.asarray(jnp.inf, dtype),    # diff
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+
+
+def rotated_cond(max_iter):
+    """while_loop predicate over the ``rotated_state0`` carry layout."""
+
+    def cond(s):
+        k = s[0]
+        converged, breakdown = s[8], s[9]
+        return (k < max_iter) & ~converged & ~breakdown
+
+    return cond
+
+
+def rotated_next_state(s, pn, w_new, r_new, z_new, zr_new, dw2,
+                       breakdown, h1, h2, delta, weighted):
+    """Scalar tail of one rotated iteration: the convergence test, the
+    breakdown holds (zr/beta frozen so the exit state matches the
+    reference's early return) and the next beta — one copy of the carry
+    algebra shared by the single-chip and sharded fused engines."""
+    k = s[0]
+    zr, beta, diff = s[5], s[6], s[7]
+    ndiff = jnp.sqrt(dw2 * h1 * h2) if weighted else jnp.sqrt(dw2)
+    converged = ~breakdown & (ndiff < delta)
+    ndiff = jnp.where(breakdown, diff, ndiff)
+    beta_new = zr_new / jnp.where(breakdown, jnp.ones_like(zr), zr)
+    return (
+        k + 1, w_new, r_new, z_new, pn,
+        jnp.where(breakdown, zr, zr_new),
+        jnp.where(breakdown, beta, beta_new),
+        ndiff, converged, breakdown,
+    )
+
+
 def _run_fused(problem: Problem, kern: _FusedKernels, coeffs, r0,
                g1: int, g2: int) -> PCGResult:
     """The rotated while_loop given prebuilt kernels + operand set."""
@@ -365,49 +415,28 @@ def _run_fused(problem: Problem, kern: _FusedKernels, coeffs, r0,
     h2 = jnp.asarray(problem.h2, dtype)
     delta = jnp.asarray(problem.delta, dtype)
     weighted = problem.norm == "weighted"
-    max_iter = problem.max_iterations
 
     z0 = r0 * dinv_p
     zr0 = jnp.sum(z0 * r0) * h1 * h2
-
-    state0 = (
-        jnp.asarray(0, jnp.int32),
-        jnp.zeros((g1p, g2p), dtype),   # w
-        r0,
-        z0,
-        jnp.zeros((g1p, g2p), dtype),   # p (beta0 = 0 makes p1 = z0)
-        zr0,
-        jnp.asarray(0.0, dtype),        # beta
-        jnp.asarray(jnp.inf, dtype),    # diff
-        jnp.asarray(False),
-        jnp.asarray(False),
+    state0 = rotated_state0(
+        jnp.zeros((g1p, g2p), dtype), r0, z0,
+        jnp.zeros((g1p, g2p), dtype), zr0, dtype,
     )
 
-    def cond(s):
-        k = s[0]
-        converged, breakdown = s[8], s[9]
-        return (k < max_iter) & ~converged & ~breakdown
-
     def body(s):
-        k, w, r, z, p, zr, beta, diff, _c, _bd = s
+        _k, w, r, z, p, zr, beta, _diff, _c, _bd = s
         pn, ap, denom_raw = kern.k1(beta, z, p, an, as_, bw, be, d_p)
         denom = denom_raw[0] * h1 * h2
         breakdown = denom < DENOM_GUARD
         w_new, r_new, z_new, sums = kern.k2(zr, denom, w, r, pn, ap, dinv_p)
-        zr_new = sums[0] * h1 * h2
-        dw2 = sums[1]
-        ndiff = jnp.sqrt(dw2 * h1 * h2) if weighted else jnp.sqrt(dw2)
-        converged = ~breakdown & (ndiff < delta)
-        ndiff = jnp.where(breakdown, diff, ndiff)
-        beta_new = zr_new / jnp.where(breakdown, jnp.ones_like(zr), zr)
-        return (
-            k + 1, w_new, r_new, z_new, pn,
-            jnp.where(breakdown, zr, zr_new),
-            jnp.where(breakdown, beta, beta_new),
-            ndiff, converged, breakdown,
+        return rotated_next_state(
+            s, pn, w_new, r_new, z_new, sums[0] * h1 * h2, sums[1],
+            breakdown, h1, h2, delta, weighted,
         )
 
-    out = lax.while_loop(cond, body, state0)
+    out = lax.while_loop(
+        rotated_cond(problem.max_iterations), body, state0
+    )
     k, w = out[0], out[1]
     diff, converged, breakdown = out[7], out[8], out[9]
     return PCGResult(

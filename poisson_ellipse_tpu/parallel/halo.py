@@ -23,6 +23,7 @@ Must be called inside ``shard_map`` over a mesh with axes ('x', 'y').
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -65,3 +66,17 @@ def halo_extend(u, px: int, py: int, width: int = 1):
     lo_y = _shift_lo_to_hi(u[:, -width:], AXIS_Y, py)
     hi_y = _shift_hi_to_lo(u[:, :width], AXIS_Y, py)
     return jnp.concatenate([lo_y, u, hi_y], axis=1)
+
+
+def halo_extend_stacked(us, px: int, py: int, width: int = 1):
+    """Halo exchange for k arrays in one message round.
+
+    ``us`` is (k, bm, bn): k same-shape local blocks stacked on a leading
+    axis; returns (k, bm+2w, bn+2w). vmap's collective batching keeps one
+    ``ppermute`` per direction carrying the whole (k, w, ·) slab — so
+    this is ``halo_extend``'s four messages for all k arrays together,
+    halving the message count versus k separate exchanges, which matters
+    on ICI where 1-cell halos are latency-bound, not bandwidth-bound.
+    The fused-sharded engine uses this to ship the (z, p) pair per
+    iteration (``parallel.fused_sharded``)."""
+    return jax.vmap(lambda u: halo_extend(u, px, py, width=width))(us)

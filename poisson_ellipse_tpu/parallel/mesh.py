@@ -41,10 +41,34 @@ def virtual_cpu_devices(n: int):
 
     Returns the CPU client's device list. If XLA_FLAGS already pins a
     host-device count, that count wins (XLA reads the flag once);
-    callers needing exactly ``n`` devices must check the length.
+    callers needing exactly ``n`` devices must check the length. If the
+    flag is absent and some backend already initialised in this process,
+    raises RuntimeError immediately (the env edit would be silently
+    ignored) instead of letting callers hit a confusing downstream
+    device-count error.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
+        # XLA parses XLA_FLAGS exactly once, at the first backend init: if
+        # any backend already came up in this process, the flag edit below
+        # would be silently ignored and the caller would only see a
+        # confusing "need N devices" error far downstream — fail at the
+        # cause instead, naming the ordering requirement.
+        try:
+            from jax._src import xla_bridge as _xb
+
+            initialized = _xb.backends_are_initialized()
+        except (ImportError, AttributeError):  # jax internals moved on
+            initialized = False
+        if initialized:
+            raise RuntimeError(
+                "virtual_cpu_devices must run before any JAX backend is "
+                "initialized in this process (XLA reads XLA_FLAGS only at "
+                "the first backend init, so setting the host-device-count "
+                "flag now would be silently ineffective). Call it before "
+                "any jax.devices()/jit work, or start the process with "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n}."
+            )
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count={n}".strip()
         )

@@ -214,9 +214,28 @@ def build_sharded_solver(
       "pallas" — explicit Pallas stencil kernel per shard per iteration
                  (decomposition × device kernels in one program — the
                  stage4 composition; see ``_local_pcg``).
+      "fused"  — the whole iteration as two Pallas kernels per shard
+                 (K1 p-update+stencil+denom, K2 updates+partials) with a
+                 stacked (z, p) halo exchange: 2 kernels + 2 psum +
+                 4 ppermute per iteration (``parallel.fused_sharded``;
+                 f32/bf16, host assembly only).
     """
     if mesh is None:
         mesh = make_mesh()
+    if stencil_impl == "fused":
+        # the two-kernel fused iteration composed with the mesh — its own
+        # carry layout (rotated loop) and tile-aligned shard padding live
+        # in parallel.fused_sharded
+        if assembly_mode != "host":
+            raise ValueError(
+                "stencil_impl='fused' assembles on the host (the rounded-"
+                f"once operand set); got assembly_mode={assembly_mode!r}"
+            )
+        from poisson_ellipse_tpu.parallel.fused_sharded import (
+            build_fused_sharded_solver,
+        )
+
+        return build_fused_sharded_solver(problem, mesh, dtype)
     px = mesh.shape[AXIS_X]
     py = mesh.shape[AXIS_Y]
     # interpret is a property of the MESH devices, not the process default

@@ -38,6 +38,16 @@ def test_run_once_sharded_matches_single():
     assert sharded.l2_error == pytest.approx(single.l2_error, rel=1e-6)
 
 
+def test_run_once_sharded_fused_engine():
+    """mode=sharded engine=fused drives the two-kernel per-shard path
+    end-to-end through the harness (oracle + report plumbing)."""
+    report = run_once(
+        Problem(M=40, N=40), mode="sharded", dtype="f32", engine="fused"
+    )
+    assert report.engine == "fused"
+    assert report.iters == 50 and report.converged
+
+
 def test_run_once_explicit_mesh_shape():
     report = run_once(
         Problem(M=20, N=20), mode="sharded", mesh_shape=(2, 2), dtype="f64"

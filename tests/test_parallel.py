@@ -126,6 +126,100 @@ def test_sharded_pallas_uneven_blocks():
     )
 
 
+@pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
+def test_fused_sharded_matches_single_chip(n_devices):
+    """The fused two-kernel iteration composed with the mesh: K1
+    (p-update + stencil + denom partial) and K2 (updates + partials) per
+    shard, a stacked (z, p) halo exchange and two psums per iteration —
+    2 kernels + 2 psum + 4 ppermute vs the ~8 XLA fusions of the plain
+    sharded loop (``parallel.fused_sharded``). Interpret mode on CPU."""
+    from poisson_ellipse_tpu.parallel.fused_sharded import solve_fused_sharded
+
+    problem = Problem(M=40, N=40)
+    ref = solve(problem, jnp.float32)
+    got = solve_fused_sharded(problem, mesh_of(n_devices))
+    assert int(got.iters) == int(ref.iters) == 50
+    assert bool(got.converged)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=0, atol=5e-6
+    )
+
+
+def test_fused_sharded_headline_oracle():
+    """546 iterations at 400×600 (the published stage1-4 oracle) on the
+    full 8-device mesh — the fused-sharded path at a bench-relevant
+    size, through the ``stencil_impl`` dispatch."""
+    problem = Problem(M=400, N=600)
+    got = solve_sharded(
+        problem, mesh_of(8), jnp.float32, stencil_impl="fused"
+    )
+    assert bool(got.converged)
+    assert int(got.iters) == 546
+
+
+def test_fused_sharded_uneven_blocks():
+    """Both axes need tile-aligned shard padding (13×17 nodes over 2×4)."""
+    problem = Problem(M=13, N=17)
+    ref = solve(problem, jnp.float32)
+    got = solve_sharded(
+        problem, mesh_of(8), jnp.float32, stencil_impl="fused"
+    )
+    assert got.w.shape == (14, 18)
+    assert int(got.iters) == int(ref.iters)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=0, atol=5e-6
+    )
+
+
+def test_fused_sharded_rejects_f64():
+    from poisson_ellipse_tpu.parallel.fused_sharded import solve_fused_sharded
+
+    with pytest.raises(ValueError, match="f32/bf16"):
+        solve_fused_sharded(Problem(M=10, N=10), mesh_of(2), jnp.float64)
+
+
+def test_fused_sharded_rejects_device_assembly():
+    with pytest.raises(ValueError, match="host"):
+        solve_sharded(
+            Problem(M=10, N=10), mesh_of(2), jnp.float32,
+            assembly_mode="device", stencil_impl="fused",
+        )
+
+
+def test_halo_extend_stacked_matches_per_array():
+    """The stacked (k, bm, bn) exchange must deliver exactly what k
+    separate halo_extend calls deliver, in 4 ppermutes instead of 4k."""
+    from jax.sharding import PartitionSpec as P
+
+    from poisson_ellipse_tpu.parallel.halo import halo_extend_stacked
+    from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y
+
+    mesh = mesh_of(8)
+    px, py = mesh.shape[AXIS_X], mesh.shape[AXIS_Y]
+    u = jnp.arange(8 * 12, dtype=jnp.float64).reshape(8, 12)
+    v = -2.0 * u + 1.0
+    spec = P(AXIS_X, AXIS_Y)
+
+    singles = jax.jit(
+        jax.shard_map(
+            lambda a, b: (halo_extend(a, px, py), halo_extend(b, px, py)),
+            mesh=mesh,
+            in_specs=(spec, spec),
+            out_specs=(spec, spec),
+        )
+    )(u, v)
+    stacked = jax.jit(
+        jax.shard_map(
+            lambda a, b: halo_extend_stacked(jnp.stack([a, b]), px, py),
+            mesh=mesh,
+            in_specs=(spec, spec),
+            out_specs=P(None, AXIS_X, AXIS_Y),
+        )
+    )(u, v)
+    np.testing.assert_array_equal(np.asarray(stacked[0]), np.asarray(singles[0]))
+    np.testing.assert_array_equal(np.asarray(stacked[1]), np.asarray(singles[1]))
+
+
 def test_sharded_rejects_unknown_stencil_impl():
     with pytest.raises(ValueError, match="stencil_impl"):
         solve_sharded(
