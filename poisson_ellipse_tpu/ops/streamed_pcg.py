@@ -18,7 +18,7 @@ capping it at ~1000x1500. This kernel removes that cap two ways:
 Measured residency on the bench chip (``StreamPlan(...).resident``):
 1600x2400 is **all-resident** — zero HBM bytes per iteration — while at
 2400x3200 the state alone takes ~97 MB of the ~114 MB budget, so **all
-four operands stream** (~6 array-passes/iter vs the ~13 the XLA
+four operands stream** (~5.1 array-passes/iter vs the ~13 the XLA
 while_loop streams once the working set outgrows VMEM) behind the
 double-buffered pipeline.
 
@@ -26,11 +26,20 @@ Per iteration, two tile sweeps inside one kernel (the two scalar sync
 points of PCG — alpha needs the global denom, beta the global zr — set
 the sweep-count floor):
 
-  AB  p <- r*Dinv + beta*p on tile t+1, then     (rotated p-update fused
+  AB  p <- z + beta*p on tile t+1, then          (rotated p-update fused
       ap = A(p) on tile t; denom partial          with stencil + dot on a
                                                   one-tile lag)
-  C   alpha; w += alpha*p; r -= alpha*ap;
+  C   alpha; w += alpha*p; z/r update;
       ||dw||^2 and (z, r) partials               (fused updates)
+
+In the dinv-resident regimes the state array holds r and z is formed on
+the fly (z = r·Dinv, twice per iteration, both free — dinv is VMEM-
+resident). In the all-streamed regime the state instead carries z
+itself, which moves the single dinv stream entirely into pass C (the
+z-update and the z²·(1/Dinv) inner product share it) and makes the AB
+p-update operand-free — one dinv HBM pass per iteration instead of two,
+with the published iteration counts preserved (see the z-state branch
+in ``_mega_kernel``).
 
 The stencil is the reference's algebraic form
 (``stage0/Withoutopenmp1.cpp:75-88``) with the 1/h² factors hoisted into
@@ -134,12 +143,13 @@ class StreamPlan:
         # the gate: state + the minimum (all-streamed) buffer set must fit
         self.min_stream_bytes = sum(tile_rows.values()) * row
         self.fits = budget >= self.min_stream_bytes
-        # greedy residency, highest streamed-passes-saved first (dinv is
-        # read twice per iteration, ap written+read once each); upgrading
-        # an operand to resident swaps its tile buffer for the full array
+        # greedy residency, highest streamed-passes-saved first (ap is
+        # written+read each iteration = 2 passes; dinv costs only 1 —
+        # the z-state regime reads it once, in pass C); upgrading an
+        # operand to resident swaps its tile buffer for the full array
         budget -= self.min_stream_bytes
         self.resident = {}
-        for name in ("dinv", "ap", "a", "b"):
+        for name in ("ap", "dinv", "a", "b"):
             extra = (full_rows[name] - tile_rows[name]) * row
             take = self.fits and extra <= budget
             self.resident[name] = take
@@ -150,7 +160,10 @@ class StreamPlan:
         """HBM array-passes per iteration (for the roofline report)."""
         p = 0.0
         if not self.resident["dinv"]:
-            p += 2.0
+            # read once, in pass C only: the all-streamed regime carries
+            # z (= Dinv·r) as the resident state, so the AB sweep's
+            # p-update needs no operand at all (``_mega_kernel``)
+            p += 1.0
         if not self.resident["ap"]:
             p += 2.0
         if not self.resident["a"]:
@@ -207,7 +220,7 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
     # the next tile overlaps the current tile's compute. Resident operands
     # hold the full array and read directly.
     _SEM = {"dinv": 0, "a": 2, "b": 4, "ap": 6}
-    # slot stride (rows per slot) derived from the plan's 2-slot buffers
+    # rows per buffer slot
     _ALLOC = {k: v // _NSLOT for k, v in plan.tile_rows.items()}
     _BUF = {"dinv": dinv_buf, "a": a_buf, "b": b_buf, "ap": ap_buf}
     _HBM = {"dinv": dinv_hbm, "a": a_hbm, "b": b_hbm, "ap": ap_hbm}
@@ -220,43 +233,14 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
             sems.at[_SEM[name] + slot],
         )
 
-    def _when_static(pred, fn):
-        """pl.when that also accepts a Python-bool predicate (the
-        _pipelined prologue calls loaders with concrete tile indices)."""
-        if isinstance(pred, bool):
-            if pred:
-                fn()
-        else:
-            pl.when(pred)(fn)
-
-    def _loader(name, lead=0):
-        """(start, wait) pair for the pipelined loop; None if resident.
-
-        lead shifts the fetched tile ahead of the sweep index (guarded
-        against the end of the grid) — the fused A+B sweep consumes dinv
-        at tile t+1 while the stencil operands ride at tile t.
-        """
+    def _loader(name):
+        """(start, wait) pair for the pipelined loop; None if resident."""
         if res[name]:
             return None
-        if lead == 0:
-            return (
-                lambda t, slot: _load_copy(name, t, slot).start(),
-                lambda t, slot: _load_copy(name, t, slot).wait(),
-            )
-
-        def start(t, slot):
-            _when_static(
-                t + lead < n_tiles,
-                lambda: _load_copy(name, t + lead, slot).start(),
-            )
-
-        def wait(t, slot):
-            _when_static(
-                t + lead < n_tiles,
-                lambda: _load_copy(name, t + lead, slot).wait(),
-            )
-
-        return (start, wait)
+        return (
+            lambda t, slot: _load_copy(name, t, slot).start(),
+            lambda t, slot: _load_copy(name, t, slot).wait(),
+        )
 
     def _read(name, t, slot, rows):
         """Tile rows of a (possibly resident) operand after its wait."""
@@ -309,7 +293,12 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
 
     def _zr0_tile(t, slot, acc):
         rt = r_s[pl.ds(t * tm, tm), :]
-        return acc + jnp.sum((rt * _read("dinv", t, slot, tm)) * rt)
+        zt = rt * _read("dinv", t, slot, tm)
+        if not res["dinv"]:
+            # the all-streamed regime carries z = Dinv·r as its resident
+            # state (see the body's z-state branch): convert r0 in place
+            r_s[pl.ds(t * tm, tm), :] = zt
+        return acc + jnp.sum(zt * rt)
 
     zr0 = _pipelined(
         [_loader("dinv")], _zr0_tile, jnp.zeros((), dtype)
@@ -372,41 +361,19 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
     def body(c):
         k, zr, beta, diff, _cv, _bd = c
 
-        def p_update(t, slot=0):
-            # p <- r*Dinv + beta*p on tile t
+        def p_update(t, dv=None):
+            # p <- z + beta*p on tile t; in the r-state regime z is formed
+            # on the fly as r·Dinv (dv = that tile's dinv rows), in the
+            # z-state regime the state array already holds z (dv=None)
             rows = pl.ds(_BAND + t * tm, tm)
-            p_s[rows, :] = (
-                r_s[pl.ds(t * tm, tm), :] * _read("dinv", t, slot, tm)
-                + beta * p_s[rows, :]
-            )
+            zt = r_s[pl.ds(t * tm, tm), :]
+            if dv is not None:
+                zt = zt * dv
+            p_s[rows, :] = zt + beta * p_s[rows, :]
 
-        # Fused passes A+B in ONE sweep on a one-tile lag: step t updates
-        # p on tile t+1 then applies the stencil to tile t, whose
-        # row-neighbour reads touch only tiles t-1..t+1 — all already
-        # updated. The per-tile arithmetic and accumulation order are
-        # identical to separate A-then-B sweeps (bitwise-same results);
-        # what changes is one fewer walk of the VMEM-resident state and
-        # one fewer DMA pipeline drain per iteration, and the dinv loads
-        # overlap the a/b loads in the streamed regime (dinv's loader
-        # rides one tile ahead — _loader(lead=1)).
-        if res["dinv"]:
-            p_update(0)
-        else:
-            # tile 0's dinv one-shot: slot 1 is free until the pipelined
-            # loop's own prefetches reach it (they start at slot 0)
-            cp = _load_copy("dinv", 0, 1)
-            cp.start()
-            cp.wait()
-            p_update(0, 1)
-
-        # Streamed ap stores lag two tiles behind (same slot), so a slot
-        # is only rewritten after its previous store has drained.
-        def pass_ab(t, slot, acc):
-            @pl.when(t + 1 < n_tiles)
-            def _():
-                p_update(t + 1, slot)
-
-            apt, pc = stencil_tile(t, slot)
+        def store_ap(t, slot, apt):
+            # Streamed ap stores lag two tiles behind (same slot), so a
+            # slot is only rewritten after its previous store has drained.
             if res["ap"]:
                 ap_buf[pl.ds(t * tm, tm), :] = apt
             else:
@@ -416,37 +383,114 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
 
                 ap_buf[pl.ds(slot * tm, tm), :] = apt
                 _ap_store_copy(t, slot).start()
+
+        def drain_ap_stores():
+            if not res["ap"]:
+                # trailing stores (n_tiles is static: unrolls)
+                for t_tail in range(max(n_tiles - _NSLOT, 0), n_tiles):
+                    _ap_store_copy(t_tail, t_tail % _NSLOT).wait()
+
+        # Fused passes A+B in ONE sweep on a one-tile lag: step t updates
+        # p on tile t+1 then applies the stencil to tile t, whose
+        # row-neighbour reads touch only tiles t-1..t+1 — all already
+        # updated. The per-tile arithmetic and accumulation order are
+        # identical to separate A-then-B sweeps (bitwise-same results);
+        # what changes is one fewer walk of the VMEM-resident state and
+        # one fewer DMA pipeline drain per iteration.
+        #
+        # The state-array regime decides what the p-update reads: with
+        # dinv resident the state is r and z is formed on the fly
+        # (dv_at(t)); in the streamed-dinv z-state regime the state
+        # already holds z (dv_at is None) — see pass C below.
+        dv_at = (
+            (lambda t: _BUF["dinv"][pl.ds(t * tm, tm), :])
+            if res["dinv"]
+            else (lambda t: None)
+        )
+        p_update(0, dv_at(0))
+
+        def pass_ab(t, slot, acc):
+            @pl.when(t + 1 < n_tiles)
+            def _():
+                p_update(t + 1, dv_at(t + 1))
+
+            apt, pc = stencil_tile(t, slot)
+            store_ap(t, slot, apt)
             return acc + jnp.sum(apt * pc)
 
         denom = _pipelined(
-            [_loader("dinv", lead=1), _loader("a"), _loader("b")],
+            [_loader("a"), _loader("b")],
             pass_ab, jnp.zeros((), dtype),
         ) * h1h2
-        if not res["ap"]:
-            # drain the trailing stores (n_tiles is static: unrolls)
-            for t_tail in range(max(n_tiles - _NSLOT, 0), n_tiles):
-                _ap_store_copy(t_tail, t_tail % _NSLOT).wait()
+        drain_ap_stores()
 
         breakdown = denom < DENOM_GUARD
         alpha = zr / jnp.where(breakdown, jnp.ones_like(denom), denom)
         alpha = jnp.where(breakdown, jnp.zeros_like(alpha), alpha)
 
-        # pass C: fused updates + both reductions
-        def pass_c(t, slot, acc):
-            dw2a, zra = acc
-            rows = pl.ds(t * tm, tm)
-            w = w_s[rows, :]
-            w_new = w + alpha * p_s[pl.ds(_BAND + t * tm, tm), :]
-            dw = w_new - w
-            w_s[rows, :] = w_new
-            r_new = r_s[rows, :] - alpha * _read("ap", t, slot, tm)
-            r_s[rows, :] = r_new
-            return (
-                dw2a + jnp.sum(dw * dw),
-                zra + jnp.sum((r_new * _read("dinv", t, slot, tm)) * r_new),
-            )
+        if res["dinv"]:
+            # -- r-state pass C: fused updates + both reductions (dinv
+            # reads are free — it is VMEM-resident)
+            def pass_c(t, slot, acc):
+                dw2a, zra = acc
+                rows = pl.ds(t * tm, tm)
+                w = w_s[rows, :]
+                w_new = w + alpha * p_s[pl.ds(_BAND + t * tm, tm), :]
+                dw = w_new - w
+                w_s[rows, :] = w_new
+                r_new = r_s[rows, :] - alpha * _read("ap", t, slot, tm)
+                r_s[rows, :] = r_new
+                return (
+                    dw2a + jnp.sum(dw * dw),
+                    zra + jnp.sum((r_new * dv_at(t)) * r_new),
+                )
+
+            c_loaders = [_loader("ap")]
+        else:
+            # -- streamed-dinv z-state pass C. The resident state array
+            # carries z = Dinv·r instead of r (converted at init —
+            # ``_zr0_tile``), so the AB p-update above needed NO operand
+            # stream, and here
+            #   z <- z − alpha·(Dinv·ap) and the next inner product
+            #   Σ z·r = Σ z²·(1/Dinv)
+            # both come off the ONE dinv stream (the guarded per-element
+            # reciprocal costs VPU divides, but pass C is bandwidth-bound
+            # with slack). One dinv pass and one pipeline drain fewer per
+            # iteration than the r-state form (6.06 -> 5.06 passes at
+            # 2400x3200). The per-element z evolution rounds differently
+            # from (r − alpha·ap)·Dinv, but — unlike the scalar zr
+            # recurrence of pipelined-CG, which drifts the convergence
+            # sequence — it preserves the published iteration-count
+            # oracles exactly (176 @ 200x132, 546 @ 400x600 verified
+            # elementwise on the host; 2449 @ 2400x3200 asserted by the
+            # bench on hardware).
+            def pass_c(t, slot, acc):
+                dw2a, zra = acc
+                rows = pl.ds(t * tm, tm)
+                w = w_s[rows, :]
+                w_new = w + alpha * p_s[pl.ds(_BAND + t * tm, tm), :]
+                dw = w_new - w
+                w_s[rows, :] = w_new
+                dvt = _read("dinv", t, slot, tm)
+                z_new = r_s[rows, :] - alpha * (
+                    dvt * _read("ap", t, slot, tm)
+                )
+                r_s[rows, :] = z_new
+                # guarded reciprocal: d = 1/Dinv on the interior, 0 off it
+                dt = jnp.where(
+                    dvt != 0.0,
+                    1.0 / jnp.where(dvt != 0.0, dvt, jnp.ones_like(dvt)),
+                    jnp.zeros_like(dvt),
+                )
+                return (
+                    dw2a + jnp.sum(dw * dw),
+                    zra + jnp.sum((z_new * z_new) * dt),
+                )
+
+            c_loaders = [_loader("ap"), _loader("dinv")]
+
         dw2, zr_raw = _pipelined(
-            [_loader("ap"), _loader("dinv")], pass_c,
+            c_loaders, pass_c,
             (jnp.zeros((), dtype), jnp.zeros((), dtype)),
         )
         zr_new = zr_raw * h1h2
@@ -546,7 +590,7 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
         ),
         scratch_shapes=[
             pltpu.VMEM((g1p, g2p), dtype),             # w
-            pltpu.VMEM((g1p, g2p), dtype),             # r
+            pltpu.VMEM((g1p, g2p), dtype),             # r (z when streamed)
             pltpu.VMEM((g1p + 2 * _BAND, g2p), dtype),  # p with bands
             buf("dinv"),
             buf("a"),

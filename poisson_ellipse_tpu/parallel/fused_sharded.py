@@ -63,31 +63,17 @@ from poisson_ellipse_tpu.ops.fused_pcg import (
     rotated_next_state,
     rotated_state0,
 )
+from poisson_ellipse_tpu.ops.pallas_kernels import _row_tile, round_up
 from poisson_ellipse_tpu.parallel.halo import halo_extend, halo_extend_stacked
 from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
 
 MESH_AXES = (AXIS_X, AXIS_Y)
 
-# VMEM budget for one kernel invocation's live windows/blocks (the
-# per-shard analog of ops.pallas_kernels._VMEM_BUDGET_BYTES).
-_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
-
-
-def _round_up(n: int, m: int) -> int:
-    return -(-n // m) * m
-
-
-def _row_tile(bm: int, cols: int, itemsize: int, n_buffers: int) -> int:
-    """Largest 8-multiple divisor of bm whose n_buffers live buffers fit
-    the VMEM budget (bm is 8-aligned by the fused-sharded padding)."""
-    row_bytes = cols * itemsize * n_buffers * 2
-    cap = max(_VMEM_BUDGET_BYTES // max(row_bytes, 1), 8)
-    best = 8
-    for tm in range(8, min(cap, bm) + 1, 8):
-        if bm % tm == 0:
-            best = tm
-    return best
+# _row_tile / round_up are the shared VMEM-tile heuristic of
+# ops.pallas_kernels — one copy, so a future budget fix cannot diverge
+# between the single-chip and sharded engines (bm is 8-aligned by the
+# fused-sharded padding, which is what _row_tile's divisor scan needs).
 
 
 def padded_dims_fused(node_shape, mesh: Mesh) -> tuple[int, int]:
@@ -95,7 +81,7 @@ def padded_dims_fused(node_shape, mesh: Mesh) -> tuple[int, int]:
     g1, g2 = node_shape
     px = mesh.shape[AXIS_X]
     py = mesh.shape[AXIS_Y]
-    return _round_up(g1, 8 * px), _round_up(g2, 128 * py)
+    return round_up(g1, 8 * px), round_up(g2, 128 * py)
 
 
 def _k1_kernel(h1, h2, tm, bn, n_tiles,

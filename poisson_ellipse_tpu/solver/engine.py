@@ -10,7 +10,7 @@ best path by default:
   engine       capacity (f32)                measured vs XLA (bench chip)
   ---------    ---------------------------   ----------------------------
   resident     whole solve in VMEM           4.0-5.8x  (<= ~1100x1650)
-  streamed     state in VMEM, ops streamed   1.6-1.9x  (<= ~2400x3200)
+  streamed     state in VMEM, ops streamed   1.6-2.0x  (<= ~2400x3200)
   fused        two-kernel HBM iteration      ~1.2x     (small-mid grids)
   xla          lax.while_loop, XLA-fused     1.0x      (any grid, any dtype)
   pallas       XLA loop + per-op Pallas      ~1.0x     (comparison engine:

@@ -143,6 +143,36 @@ def test_streamed_forced_all_streaming_parity(monkeypatch):
     )
 
 
+def test_stream_plan_residency_prefers_ap(monkeypatch):
+    """The greedy residency upgrade takes ap (written+read = 2 HBM
+    passes/iter) before dinv (1 pass — the z-state regime reads it only
+    in pass C); with budget for exactly one full array the plan must
+    keep ap resident and stream dinv, and the solve in that mixed
+    regime (z-state + resident ap) must still match the XLA path."""
+    import poisson_ellipse_tpu.ops.streamed_pcg as sp
+
+    problem = Problem(M=200, N=132, norm="weighted")
+    ref = solve_xla(problem, jnp.float32)
+    base = StreamPlan(problem, jnp.float32, tm=64)
+    state_bytes = (3 * base.g1p + 16) * base.g2p * 4
+    ap_upgrade = (
+        base.full_rows["ap"] - base.tile_rows["ap"]
+    ) * base.g2p * 4
+    monkeypatch.setattr(
+        sp, "_VMEM_USABLE",
+        state_bytes + base.min_stream_bytes + ap_upgrade,
+    )
+    plan = sp.StreamPlan(problem, jnp.float32, tm=64)
+    assert plan.resident["ap"] and not plan.resident["dinv"]
+    solver, args = sp.build_streamed_solver(problem, jnp.float32, tm=64)
+    got = solver(*args)
+    assert int(got.iters) == int(ref.iters)
+    assert bool(got.converged)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), atol=5e-6
+    )
+
+
 def test_stream_plan_shapes():
     plan = StreamPlan(Problem(M=1600, N=2400), jnp.float32)
     assert plan.g1p % plan.tm == 0
