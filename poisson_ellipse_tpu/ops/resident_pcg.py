@@ -44,9 +44,11 @@ from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.ops.fused_pcg import fused_operands
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
+from poisson_ellipse_tpu.utils.device import scaled_vmem_budget
 
-# Measured usable VMEM on the bench part (128 MiB minus compiler
-# reserves).
+# Measured usable VMEM on the 128 MiB bench part (minus compiler
+# reserves); scaled to the actual device's capacity at the use sites
+# via ``utils.device.scaled_vmem_budget`` (device_kind-keyed table).
 _VMEM_LIMIT = 127 * 1024 * 1024
 _RESIDENT_BUDGET = 125 * 1024 * 1024
 # Empirical working-set envelope: operands (6 coeffs + rhs) + scratch
@@ -70,11 +72,12 @@ def padded_shape(problem: Problem) -> tuple[int, int]:
     return _round_up(g1, 8), _round_up(g2, 128)
 
 
-def fits_resident(problem: Problem, dtype=jnp.float32) -> bool:
-    """True if the whole solve's working set fits on-chip."""
+def fits_resident(problem: Problem, dtype=jnp.float32, device=None) -> bool:
+    """True if the whole solve's working set fits on-chip (on ``device``'s
+    VMEM capacity; default: the default-backend device)."""
     g1p, g2p = padded_shape(problem)
     need = _ARRAYS_RESIDENT * g1p * g2p * jnp.dtype(dtype).itemsize
-    return need <= _RESIDENT_BUDGET
+    return need <= scaled_vmem_budget(_RESIDENT_BUDGET, device)
 
 
 def _shift_rows_down(x):
@@ -238,7 +241,7 @@ def build_resident_solver(problem: Problem, dtype=jnp.float32,
             pltpu.VMEM((g1p, g2p), dtype),  # p
         ],
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT
+            vmem_limit_bytes=scaled_vmem_budget(_VMEM_LIMIT)
         ),
         interpret=interpret,
     )

@@ -70,7 +70,10 @@ from jax.experimental.pallas import tpu as pltpu
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
+from poisson_ellipse_tpu.utils.device import scaled_vmem_budget
 
+# measured on the 128 MiB bench part; scaled to the actual device's
+# capacity at the use sites (utils.device, device_kind-keyed)
 _VMEM_LIMIT = 127 * 1024 * 1024
 _VMEM_USABLE = 114 * 1024 * 1024  # leave headroom for Mosaic temps
 _BAND = 8  # zero band rows above/below the p scratch
@@ -93,9 +96,15 @@ class StreamPlan:
     (measured ~12% per iteration at 1600x2400 all-resident) but eat VMEM
     that the greedy residency pass and Mosaic temporaries want; 256 was
     measured slower (it demotes an operand to streamed).
+
+    device — whose VMEM capacity bounds the plan (default: the
+    default-backend device); the measured 128 MiB-part budget is scaled
+    to it via ``utils.device.scaled_vmem_budget``.
     """
 
-    def __init__(self, problem: Problem, dtype, tm: int | None = None):
+    def __init__(self, problem: Problem, dtype, tm: int | None = None,
+                 device=None):
+        self.device = device
         if tm is None:
             self._compute(problem, dtype, 64)
             fits64 = self.fits
@@ -128,7 +137,7 @@ class StreamPlan:
         self.n_tiles = self.g1p // self.tm
         item = jnp.dtype(dtype).itemsize
         row = self.g2p * item
-        budget = _VMEM_USABLE
+        budget = scaled_vmem_budget(_VMEM_USABLE, self.device)
         # state is always resident: w, r + p with its zero bands
         budget -= (3 * self.g1p + 2 * _BAND) * row
         # per-operand buffer rows: streamed operands get a double-buffered
@@ -173,15 +182,16 @@ class StreamPlan:
         return p
 
 
-def fits_streamed(problem: Problem, dtype=jnp.float32) -> bool:
+def fits_streamed(problem: Problem, dtype=jnp.float32, device=None) -> bool:
     """True if the always-resident PCG state (w, r, banded p) plus the
-    minimum double-buffered stream buffers fit the VMEM budget.
+    minimum double-buffered stream buffers fit the VMEM budget (scaled
+    to ``device``'s capacity).
 
     The state itself cannot be streamed (it is read and written every
     pass of every iteration), so grids past this gate — e.g. the 4097²
     node grid, whose state alone is ~201 MB — need the sharded path.
     """
-    return StreamPlan(problem, dtype).fits
+    return StreamPlan(problem, dtype, device=device).fits
 
 
 def _shift_cols_right(x):
@@ -599,7 +609,7 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
             pltpu.SemaphoreType.DMA((8,)),
         ],
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT
+            vmem_limit_bytes=scaled_vmem_budget(_VMEM_LIMIT)
         ),
         interpret=interpret,
     )

@@ -48,16 +48,23 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 ENGINES = ("auto", "xla", "fused", "resident", "streamed", "pallas")
 
 
-def select_engine(problem: Problem, dtype=jnp.float32) -> str:
-    """The concrete engine "auto" resolves to for this problem/dtype."""
+def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
+    """The concrete engine "auto" resolves to for this problem/dtype.
+
+    The capacity gates scale with ``device``'s VMEM size
+    (``utils.device``'s device_kind table; default: the default-backend
+    device), so a larger-VMEM part keeps the resident/streamed engines
+    up to proportionally larger grids instead of silently under-
+    selecting with the bench part's budgets.
+    """
     from poisson_ellipse_tpu.ops.resident_pcg import fits_resident
     from poisson_ellipse_tpu.ops.streamed_pcg import fits_streamed
 
     if jnp.dtype(dtype).itemsize >= 8:
         return "xla"
-    if fits_resident(problem, dtype):
+    if fits_resident(problem, dtype, device):
         return "resident"
-    if fits_streamed(problem, dtype):
+    if fits_streamed(problem, dtype, device):
         return "streamed"
     return "xla"
 

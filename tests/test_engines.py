@@ -173,6 +173,37 @@ def test_stream_plan_residency_prefers_ap(monkeypatch):
     )
 
 
+def test_select_engine_scales_with_device_vmem(monkeypatch):
+    """The capacity gates key off device_kind VMEM capacity
+    (``utils.device``): a small-VMEM part must drop 800x1200 out of the
+    resident engine, a large-VMEM part must pull 1600x2400 into it —
+    both with the injected kinds, while unknown kinds reproduce the
+    measured bench-part behaviour exactly."""
+    from poisson_ellipse_tpu.solver.engine import select_engine
+    from poisson_ellipse_tpu.utils import device as devmod
+
+    class _Fake:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    monkeypatch.setitem(devmod._VMEM_CAPACITY, "TPU tiny-test", 32 * 1024 * 1024)
+    monkeypatch.setitem(devmod._VMEM_CAPACITY, "TPU big-test", 512 * 1024 * 1024)
+    small, big = _Fake("TPU tiny-test"), _Fake("TPU big-test")
+    # measured part: 800x1200 resident, 1600x2400 streamed
+    assert select_engine(Problem(M=800, N=1200)) == "resident"
+    assert select_engine(Problem(M=1600, N=2400)) == "streamed"
+    # quarter-VMEM part: 800x1200 no longer fits resident
+    assert not fits_resident(Problem(M=800, N=1200), device=small)
+    assert select_engine(Problem(M=800, N=1200), device=small) == "streamed"
+    # 4x-VMEM part: 1600x2400 becomes resident, 4096^2 becomes streamable
+    assert select_engine(Problem(M=1600, N=2400), device=big) == "resident"
+    assert select_engine(Problem(M=4096, N=4096), device=big) == "streamed"
+    # unknown kind falls back to the measured budgets
+    assert select_engine(
+        Problem(M=800, N=1200), device=_Fake("mystery")
+    ) == "resident"
+
+
 def test_stream_plan_shapes():
     plan = StreamPlan(Problem(M=1600, N=2400), jnp.float32)
     assert plan.g1p % plan.tm == 0
@@ -358,6 +389,35 @@ def test_engines_agree_on_general_problems(cfg):
         got = fn(problem, jnp.float32)
         assert int(got.iters) == int(ref.iters), name
         assert bool(got.converged), name
+        np.testing.assert_allclose(
+            np.asarray(got.w), np.asarray(ref.w), atol=5e-6, err_msg=name
+        )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_engine_parity_on_random_configurations(seed):
+    """Oracle invariance over RANDOM configurations (SURVEY §4): every
+    engine must converge in the same iteration count as the XLA path on
+    randomly drawn boxes/ε/f/grids, seed-parametrised — the fixed-config
+    generality cases above can miss mask geometries the random draw
+    hits (cut cells at different face fractions, extreme ε)."""
+    rng = np.random.default_rng(1000 + seed)
+    problem = Problem(
+        M=int(rng.integers(24, 56)),
+        N=int(rng.integers(24, 56)),
+        a1=-float(rng.uniform(1.05, 1.6)),
+        b1=float(rng.uniform(1.05, 1.6)),
+        a2=-float(rng.uniform(0.55, 1.0)),
+        b2=float(rng.uniform(0.55, 1.0)),
+        eps=float(10.0 ** rng.uniform(-6, -1)),
+        f_val=float(rng.uniform(0.2, 3.0)),
+    )
+    ref = solve_xla(problem, jnp.float32)
+    assert bool(ref.converged)
+    for name, fn in ENGINES.items():
+        got = fn(problem, jnp.float32)
+        assert int(got.iters) == int(ref.iters), (name, problem)
+        assert bool(got.converged), (name, problem)
         np.testing.assert_allclose(
             np.asarray(got.w), np.asarray(ref.w), atol=5e-6, err_msg=name
         )
