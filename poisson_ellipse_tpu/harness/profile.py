@@ -180,14 +180,58 @@ def profile_sharded(
         # rescale to keep the chain alive and the magnitude bounded
         return u_blk * (s / jnp.where(s == 0.0, 1.0, s))
 
-    # no "update" entry here: the axpy/norm update is measured by the
-    # single-device profile; reporting it as 0.0 would misattribute
-    # sharded iteration time
+    def time_update() -> float:
+        """The per-shard w/r axpy + realised ‖Δw‖² partial (the stage4
+        ``update_w_r_kernel`` analog). The partial's psum rides with the
+        zr collective in the real loop (one batched psum —
+        ``parallel.pcg_sharded._shard_advance``), so only the local
+        reduction is timed here; a/b stand in for p/ap (same shapes,
+        same sharding). The chain stays data-dependent through a ~1.0
+        rescale by the partial, costing one extra elementwise pass —
+        a slight overestimate, exactly like the dot phase's carry."""
+        alpha = jnp.asarray(1e-3, dtype)
+
+        def make(n: int):
+            def blk_fn(w_blk, r_blk, a_blk, b_blk):
+                def step(_, st):
+                    w, r = st
+                    w_new = w + alpha * a_blk
+                    r_new = r - alpha * b_blk
+                    dw = w_new - w
+                    dw2 = jnp.sum(dw * dw)
+                    w_new = w_new * (
+                        dw2 / jnp.where(dw2 == 0.0, 1.0, dw2)
+                    )
+                    return (w_new, r_new)
+
+                return lax.fori_loop(0, n, step, (w_blk, r_blk))
+
+            return jax.jit(
+                jax.shard_map(
+                    blk_fn,
+                    mesh=mesh,
+                    in_specs=(spec, spec, spec, spec),
+                    out_specs=(spec, spec),
+                )
+            )
+
+        def timed(n: int) -> float:
+            fn = make(n)
+            out = fn(rhs, rhs, a, b)
+            fence(out)
+            t0 = time.perf_counter()
+            out = fn(rhs, rhs, a, b)
+            fence(out)
+            return time.perf_counter() - t0
+
+        return max(timed(5 * reps) - timed(reps), 0.0) / (4 * reps)
+
     phases = {
         "halo": time_fn(halo_step, rhs),
         "stencil": time_fn(stencil_step, rhs),
         "precond": time_fn(precond_step, rhs),
         "dot": time_fn(dot_step, rhs),
+        "update": time_update(),
     }
     # the stencil phase includes its own halo exchange (as stage4's T_gpu
     # excludes but T_copy/T_mpi include theirs); subtract for the pure part

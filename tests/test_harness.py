@@ -117,6 +117,19 @@ def test_profile_single_phases():
     assert "t_stencil" in text and "x10 iters" in text
 
 
+def test_profile_sharded_phases():
+    """The sharded table covers every stage4 accumulator analog —
+    including the update/axpy phase (``update_w_r_kernel``), which used
+    to be single-device-only (``poisson_mpi_cuda2.cu:696-700``)."""
+    from poisson_ellipse_tpu.harness.profile import profile_sharded
+
+    phases = profile_sharded(Problem(M=32, N=32), reps=5)
+    assert set(phases) == {
+        "halo", "stencil", "stencil_pure", "precond", "dot", "update",
+    }
+    assert all(v >= 0.0 for v in phases.values())
+
+
 def test_cli_native_backend(capsys):
     from poisson_ellipse_tpu.runtime import native_available
 
@@ -233,8 +246,11 @@ def test_bench_f64_row_oracle():
     )
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
-    assert bench.bench_f64_row(grid=(40, 40), oracle=50) is True
-    assert bench.bench_f64_row(grid=(40, 40), oracle=999) is False
+    ok, row = bench.bench_f64_row(grid=(40, 40), oracle=50)
+    assert ok is True
+    assert row["grid"] == [40, 40] and row["iters"] == 50
+    ok, _ = bench.bench_f64_row(grid=(40, 40), oracle=999)
+    assert ok is False
 
 
 def test_cli_threads_sweep_conflicting_flags(capsys):
