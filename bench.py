@@ -48,7 +48,7 @@ EPS_GRID = (1024, 1024)
 EPS_VALUES = (1e-2, 1e-3, 1e-4, 1e-5, 1e-6)
 
 
-def bench_grid(M: int, N: int, oracle: int):
+def bench_grid(M: int, N: int, oracle: int, ref_t: float | None):
     # run_once provides the measurement protocol: warm-up outside the
     # timed region, then the chained differential — each rep times one
     # plain dispatch and one chained dispatch of BATCH data-dependent
@@ -72,10 +72,20 @@ def bench_grid(M: int, N: int, oracle: int):
         + report.roofline_line(),
         file=sys.stderr,
     )
-    return report.t_solver, ok
+    row = {
+        "grid": [M, N],
+        "t_solver_s": round(report.t_solver, 5),
+        "iters": report.iters,
+        "converged": report.converged,
+        "engine": report.engine,
+        "l2_error": report.l2_error,
+        "ref_p100_s": ref_t,
+        "vs_p100": round(ref_t / report.t_solver, 2) if ref_t else None,
+    }
+    return report.t_solver, ok, row
 
 
-def bench_f64_row(grid: tuple[int, int] = HEADLINE, oracle: int = 989) -> bool:
+def bench_f64_row(grid: tuple[int, int] = HEADLINE, oracle: int = 989):
     """The f64 fidelity row: the reference is entirely double precision
     (SURVEY §7 names TPU f64 the single biggest fidelity risk), so the
     bench proves the emulated-f64 path converges in exactly the published
@@ -92,7 +102,15 @@ def bench_f64_row(grid: tuple[int, int] = HEADLINE, oracle: int = 989) -> bool:
         f"engine={report.engine} l2_err={report.l2_error:.3e}",
         file=sys.stderr,
     )
-    return ok
+    row = {
+        "grid": [M, N],
+        "t_solver_s": round(report.t_solver, 5),
+        "iters": report.iters,
+        "converged": report.converged,
+        "engine": report.engine,
+        "l2_error": report.l2_error,
+    }
+    return ok, row
 
 
 def bench_baseline_config(M: int, N: int, label: str, amortised: bool):
@@ -185,9 +203,11 @@ def bench_eps_sweep():
 def main() -> int:
     print(f"devices: {jax.devices()}", file=sys.stderr)
     headline_t, baseline, all_ok = None, None, True
+    grid_rows = []
     for M, N, oracle, ref_t in GRIDS:
-        t, ok = bench_grid(M, N, oracle)
+        t, ok, row = bench_grid(M, N, oracle, ref_t)
         all_ok &= ok
+        grid_rows.append(row)
         if ref_t is not None:
             print(
                 f"    vs stage4 1-GPU P100 ({ref_t}s): {ref_t / t:.2f}x",
@@ -202,7 +222,8 @@ def main() -> int:
     all_ok &= ok2 & okn & oke
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
-    all_ok &= bench_f64_row()
+    okf, f64_row = bench_f64_row()
+    all_ok &= okf
     print(
         json.dumps(
             {
@@ -211,9 +232,13 @@ def main() -> int:
                 "unit": "s",
                 "vs_baseline": round(baseline / headline_t, 2),
                 "valid": all_ok,
+                # machine-readable rows: tools/update_readme_bench.py
+                # regenerates the README's measured table from these
+                "grids": grid_rows,
                 "config2": config2,
                 "north_star": north,
                 "eps_sweep": eps_rows,
+                "f64": f64_row,
             }
         )
     )

@@ -1,0 +1,138 @@
+"""Regenerate README.md's measured-performance blocks from a BENCH artifact.
+
+The README's headline speedup and measured table are GENERATED — not
+hand-edited — from the machine-readable JSON line `bench.py` prints
+(which the round driver archives as `BENCH_r{N}.json`). One number, one
+source:
+
+    python bench.py > /tmp/bench.json   # or use the driver's BENCH_r*.json
+    python tools/update_readme_bench.py [/tmp/bench.json]
+
+With no argument the newest `BENCH_r*.json` in the repo root is used.
+Both formats are accepted: the driver artifact (``{"parsed": {...}}``)
+and bench.py's raw stdout line. The tool rewrites the text between the
+``<!-- bench:... -->`` marker pairs in README.md and leaves everything
+else untouched; artifacts from before the machine-readable "grids" key
+are rejected with a pointer to re-run the bench.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+README = os.path.join(ROOT, "README.md")
+
+
+def load_artifact(path: str | None) -> tuple[dict, str]:
+    """(parsed bench record, source label)."""
+    if path is None:
+        rounds = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+        if not rounds:
+            raise SystemExit("no BENCH_r*.json found; pass a path")
+        path = rounds[-1]
+    with open(path) as f:
+        data = json.load(f)
+    rec = data.get("parsed", data)  # driver artifact vs raw bench line
+    if "grids" not in rec:
+        raise SystemExit(
+            f"{path} predates the machine-readable bench rows; re-run "
+            "`python bench.py > out.json` and pass that file"
+        )
+    return rec, os.path.basename(path)
+
+
+def fmt_t(t: float) -> str:
+    return f"{t:.4f} s" if t < 1 else f"{t:.2f} s"
+
+
+def headline_block(rec: dict, src: str) -> str:
+    return (
+        f"Measured headline: **{fmt_t(rec['value'])}** for 800×1200 "
+        f"(989 iterations to δ=1e-6) on one TPU v5e chip — "
+        f"**{rec['vs_baseline']:g}×** the reference's stage4 single-P100 "
+        f"0.83 s. (Generated from `{src}` by "
+        f"`tools/update_readme_bench.py` — the same artifact as the "
+        f"table below.)"
+    )
+
+
+def table_block(rec: dict, src: str) -> str:
+    lines = [
+        "`T_solver`, median, fenced, marginal-cost protocol (host↔device "
+        "RTT cancelled); reference numbers from `BASELINE.md` (P100). "
+        f"Generated from `{src}` by `tools/update_readme_bench.py`:",
+        "",
+        "| Grid | iters | engine | this framework | stage4 1×P100 | speedup |",
+        "|---|---|---|---|---|---|",
+    ]
+    for row in rec["grids"]:
+        M, N = row["grid"]
+        ref = f"{row['ref_p100_s']} s" if row.get("ref_p100_s") else "—"
+        vs = f"**{row['vs_p100']:g}×**" if row.get("vs_p100") else "—"
+        bold = "**" if [M, N] == [800, 1200] else ""
+        lines.append(
+            f"| {M}×{N} | {row['iters']} | {row['engine']} | "
+            f"{bold}{fmt_t(row['t_solver_s'])}{bold} | {ref} | {vs} |"
+        )
+    for key, note in (("config2", "BASELINE config 2"),
+                      ("north_star", "north-star config")):
+        row = rec[key]
+        M, N = row["grid"]
+        lines.append(
+            f"| {M}×{N} | {row['iters']} | {row['engine']} | "
+            f"{fmt_t(row['t_solver_s'])} | — ({note}) | — |"
+        )
+    f64 = rec["f64"]
+    eps = rec["eps_sweep"]
+    eps_iters = sorted({r["iters"] for r in eps})
+    eps_span = (
+        f"{eps_iters[0]}" if len(eps_iters) == 1
+        else f"{eps_iters[0]}–{eps_iters[-1]}"
+    )
+    M, N = rec["config2"]["grid"]
+    lines += [
+        "",
+        f"The f64 fidelity row (emulated f64 on TPU): "
+        f"{f64['grid'][0]}×{f64['grid'][1]} converges in exactly the "
+        f"published {f64['iters']} iterations at {fmt_t(f64['t_solver_s'])} "
+        "— still faster than the reference's single-P100 f32 time. The "
+        f"ε-stiffness sweep at {M}×{N} (BASELINE config 5) is flat: "
+        f"{eps_span} iterations across ε ∈ {{1e-2 … 1e-6}} — the Jacobi "
+        "preconditioner absorbs the 1/ε stiffness, so the solver does "
+        "not degrade as the fictitious domain hardens.",
+    ]
+    return "\n".join(lines)
+
+
+def splice(text: str, marker: str, replacement: str) -> str:
+    begin, end = f"<!-- bench:{marker} -->", f"<!-- /bench:{marker} -->"
+    pattern = re.compile(
+        re.escape(begin) + r".*?" + re.escape(end), flags=re.DOTALL
+    )
+    if not pattern.search(text):
+        raise SystemExit(f"README.md is missing the {begin} marker pair")
+    return pattern.sub(f"{begin}\n{replacement}\n{end}", text)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    rec, src = load_artifact(argv[0] if argv else None)
+    with open(README) as f:
+        text = f.read()
+    text = splice(text, "headline", headline_block(rec, src))
+    text = splice(text, "table", table_block(rec, src))
+    with open(README, "w") as f:
+        f.write(text)
+    print(f"README.md regenerated from {src}: headline "
+          f"{rec['value']} s / {rec['vs_baseline']}x, "
+          f"{len(rec['grids'])} grid rows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
