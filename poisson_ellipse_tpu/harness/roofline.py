@@ -75,6 +75,10 @@ def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
         return 13.0
     if engine == "fused":
         return 17.0
+    if engine == "xl":
+        from poisson_ellipse_tpu.ops.xl_pcg import XLPlan
+
+        return XLPlan(problem, dtype).passes_per_iter()
     if engine == "resident":
         return 0.0
     if engine == "streamed":

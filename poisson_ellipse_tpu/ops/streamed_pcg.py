@@ -523,6 +523,40 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
     flags_out[1] = out[5].astype(jnp.int32)
 
 
+def streamed_operand_set(problem: Problem, dtype, g1p: int, g2p: int):
+    """(dinv, an, bw, r0): f64-assembled, rounded once, zero-padded to
+    (g1p, g2p) — the operand fidelity contract shared by the streamed
+    and xl engines (one copy; see ``fused_pcg.build_fused_solver``).
+
+    dinv is the guarded 1/D from the f64 diagonal; an/bw are the
+    UNMASKED h²-normalised coefficients (identical values at interior
+    points to the fused/resident operand set) so the tile stencils'
+    south/east offset slices are valid — the in-kernel output mask
+    zeroes the ring. ``an`` carries an extra 8 padded rows for the
+    stencil's aligned (tm+8)-row DMA windows.
+    """
+    import numpy as np
+
+    from poisson_ellipse_tpu.ops.fused_pcg import (
+        interior_normalized,
+        normalized_unmasked,
+    )
+
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+    a64, b64, rhs64 = assembly.assemble_numpy(problem)
+    dinv64 = interior_normalized(problem, a64, b64)[5]
+    anu64, bwu64 = normalized_unmasked(problem, a64, b64)
+
+    def padded(x, extra_rows=0):
+        return jnp.asarray(
+            np.pad(
+                x, ((0, g1p + extra_rows - x.shape[0]), (0, g2p - x.shape[1]))
+            ).astype(np_dtype)
+        )
+
+    return (padded(dinv64), padded(anu64, 8), padded(bwu64), padded(rhs64))
+
+
 def build_streamed_solver(problem: Problem, dtype=jnp.float32,
                           interpret=None, tm: int | None = None):
     """(jitted whole-solve kernel, args) for large grids.
@@ -531,8 +565,6 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
     operand fidelity as ``fused_pcg.build_fused_solver``).
     tm — row-tile height (see StreamPlan).
     """
-    import numpy as np
-
     if jnp.dtype(dtype).itemsize >= 8:
         raise ValueError("streamed solver supports f32/bf16")
     if interpret is None:
@@ -546,30 +578,7 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
             "it on-chip; use the XLA path or the sharded solver"
         )
     g1p, g2p, tm = plan.g1p, plan.g2p, plan.tm
-    np_dtype = np.dtype(jnp.dtype(dtype).name)
-
-    a64, b64, rhs64 = assembly.assemble_numpy(problem)
-
-    def padded(x, extra_rows=0):
-        return jnp.asarray(
-            np.pad(
-                x, ((0, g1p + extra_rows - x.shape[0]), (0, g2p - x.shape[1]))
-            ).astype(np_dtype)
-        )
-
-    # guarded 1/D from the f64 diagonal — shared with the fused engine
-    from poisson_ellipse_tpu.ops.fused_pcg import interior_normalized
-
-    dinv64 = interior_normalized(problem, a64, b64)[5]
-
-    # unmasked h²-normalised coefficients (shared algebra — identical
-    # values at interior points to the fused/resident operand set, rounded
-    # once to the device dtype); unmasked so stencil_tile's south/east
-    # offset slices are valid — the output mask zeroes the ring
-    from poisson_ellipse_tpu.ops.fused_pcg import normalized_unmasked
-
-    anu64, bwu64 = normalized_unmasked(problem, a64, b64)
-    args = (padded(dinv64), padded(anu64, 8), padded(bwu64), padded(rhs64))
+    args = streamed_operand_set(problem, dtype, g1p, g2p)
 
     kernel = functools.partial(
         _mega_kernel, problem, plan, problem.norm == "weighted"

@@ -11,6 +11,7 @@ best path by default:
   ---------    ---------------------------   ----------------------------
   resident     whole solve in VMEM           4.0-5.8x  (<= ~1100x1650)
   streamed     state in VMEM, ops streamed   1.6-2.0x  (<= ~2400x3200)
+  xl           state AND ops tile-streamed   ~1.2x     (any grid size)
   fused        two-kernel HBM iteration      ~1.2x     (small-mid grids)
   xla          lax.while_loop, XLA-fused     1.0x      (any grid, any dtype)
   pallas       XLA loop + per-op Pallas      ~1.0x     (comparison engine:
@@ -18,20 +19,19 @@ best path by default:
                                                         op structure)
 
 Policy (``select_engine``): resident if the whole working set fits VMEM;
-else streamed if the state fits; else xla. f64 always takes xla — the
+else streamed if the state fits; else xl. f64 always takes xla — the
 Pallas engines are f32/bf16 (TPU f64 is emulated, and the XLA path is the
 only one with an f64 story). ``fused`` never wins outright on the bench
 chip so auto never picks it, but it remains selectable for comparison.
 
 Past the streamed gate (~2400x3200 f32; e.g. the 4096² north-star grid,
-whose w/r/p state alone is ~200 MB) xla is the *right* engine, not a
-compromise: with no state resident a custom kernel still needs two
-sweeps per iteration (PCG has two scalar sync points) costing ~14 HBM
-array-passes vs the ~13 the XLA while_loop streams, and the measured XLA
-path already runs at ~3/4 of HBM peak there — single-chip solves at that
-size are bandwidth-bound, and the framework's scaling answer is the
-sharded mesh path (``parallel.pcg_sharded``), which divides the state
-over devices until it is VMEM-resident again.
+whose state alone is ~200 MB) solves are HBM-bandwidth-bound; the xl
+kernel restructures the iteration below the XLA loop's traffic floor
+(z-state + deferred w-update: ~12.1 array-passes/iter vs ~13, at a
+higher achieved fraction of peak — measured 4.28 s vs 5.16 s at 4096²).
+The framework's *scaling* answer at that size remains the sharded mesh
+path (``parallel.pcg_sharded``), which divides the state over devices
+until it is VMEM-resident again.
 """
 
 from __future__ import annotations
@@ -45,7 +45,7 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 # the Pallas engine modules import solver.pcg at their top level (which
 # runs this package's __init__), so they are imported lazily here
 
-ENGINES = ("auto", "xla", "fused", "resident", "streamed", "pallas")
+ENGINES = ("auto", "xla", "fused", "resident", "streamed", "xl", "pallas")
 
 
 def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
@@ -66,7 +66,11 @@ def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
         return "resident"
     if fits_streamed(problem, dtype, device):
         return "streamed"
-    return "xla"
+    # past the streamed gate the state itself exceeds VMEM: the xl
+    # kernel streams state AND operands (12.1 passes/iter at ~72% of
+    # HBM peak vs the XLA loop's 13 at ~67% — measured 4.28 s vs 5.16 s
+    # at the 4096² north-star grid)
+    return "xl"
 
 
 def build_solver(
@@ -81,14 +85,14 @@ def build_solver(
     "auto" degrades gracefully: the capacity gates are budgets measured
     on the bench part, so on a chip with a different VMEM size a selected
     Pallas engine could fail Mosaic compilation — auto AOT-compiles the
-    pick and falls down the chain (resident → streamed → xla; xla cannot
-    fail this way) instead of surfacing an opaque compile error.
+    pick and falls down the chain (resident → streamed → xl → xla; xla
+    cannot fail this way) instead of surfacing an opaque compile error.
     Explicitly requested engines still fail loudly.
     """
     if engine == "auto":
         import jax
 
-        chain = ("resident", "streamed", "xla")
+        chain = ("resident", "streamed", "xl", "xla")
         chain = chain[chain.index(select_engine(problem, dtype)):]
         last_err = None
         for cand in chain:
@@ -131,6 +135,10 @@ def build_solver(
         from poisson_ellipse_tpu.ops.fused_pcg import build_fused_solver
 
         solver, args = build_fused_solver(problem, dtype, interpret=interpret)
+    elif engine == "xl":
+        from poisson_ellipse_tpu.ops.xl_pcg import build_xl_solver
+
+        solver, args = build_xl_solver(problem, dtype, interpret=interpret)
     elif engine in ("xla", "pallas"):
         # "pallas" = the XLA while_loop driving the per-op Pallas stencil
         # kernel (stage4's one-kernel-per-op structure on one chip)
