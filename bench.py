@@ -215,11 +215,16 @@ def main() -> int:
             )
         if (M, N) == HEADLINE:
             headline_t, baseline = t, ref_t
-    # BASELINE.json target configs (no reference numbers published)
+    # BASELINE.json target configs (no reference numbers published).
+    # The 8192² row is the config-4 grid on ONE chip (the xl engine
+    # streams state beyond VMEM) — the reference reaches this size only
+    # on a multi-node MPI cluster; pod weak-scaling remains
+    # bench_multichip --real's job.
     config2, ok2 = bench_baseline_config(1024, 1024, "config2", amortised=True)
     north, okn = bench_baseline_config(4096, 4096, "north-star", amortised=False)
+    xl8k, ok8 = bench_baseline_config(8192, 8192, "config4-1chip", amortised=False)
     eps_rows, oke = bench_eps_sweep()
-    all_ok &= ok2 & okn & oke
+    all_ok &= ok2 & okn & ok8 & oke
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
     okf, f64_row = bench_f64_row()
@@ -237,6 +242,7 @@ def main() -> int:
                 "grids": grid_rows,
                 "config2": config2,
                 "north_star": north,
+                "config4_1chip": xl8k,
                 "eps_sweep": eps_rows,
                 "f64": f64_row,
             }

@@ -29,8 +29,9 @@ def main(argv=None) -> int:
     ap.add_argument("--meshes", help="comma list of PXxPY meshes, e.g. 1x1,2x2,4x4")
     ap.add_argument("--dtype", default="f32")
     ap.add_argument(
-        "--engine", choices=("xla", "pallas"), default="xla",
-        help="sharded stencil engine",
+        "--engine", choices=("xla", "pallas", "fused"), default="xla",
+        help="sharded engine: xla block stencil, per-shard pallas "
+        "stencil kernel, or the fused two-kernel iteration (f32/bf16)",
     )
     ap.add_argument("--repeat", type=int, default=1)
     ap.add_argument("--batch", type=int, default=1)

@@ -32,13 +32,14 @@ floor):
   C   z -= alpha*(Dinv*ap);                       reads  z, dinv, ap
       zr partial = sum(z^2 / Dinv)                writes z
 
-= ~12.03 HBM array-passes/iter (tm=256) vs the XLA loop's ~13, executed
-by the same DMA-pipeline style that measures ~78% of HBM peak in the
-streamed engine — the two factors compound into the north-star win this
-engine exists for. All per-element FP forms are shared with the
-streamed z-state regime (verified there to preserve the published
-iteration-count oracles); reductions are tile-sequential as in every
-Pallas engine.
+= ~12.08 HBM array-passes/iter vs the XLA loop's ~13, at a higher
+achieved fraction of peak. Measured (bench chip, f32): 4096² = 4.22 s
+vs 5.16 s XLA (1.22×, 3226 iterations exact, 75.5% of HBM peak);
+8192² = 28.7 s / 5889 iterations at 81.3% of peak on ONE chip — a grid
+the reference reaches only on a multi-node MPI cluster. All per-element
+FP forms are shared with the streamed z-state regime (verified there to
+preserve the published iteration-count oracles); reductions are
+tile-sequential as in every Pallas engine.
 
 Reference lineage: this is the stage4 decomposition taken to its
 single-chip limit — where ``poisson_mpi_cuda2.cu:846-939`` launches six

@@ -76,6 +76,17 @@ def test_rejects_unknown_kind():
         scaling_table("diagonal", (10, 10), [(1, 1)])
 
 
+def test_table_runs_fused_engine():
+    """The fused two-kernel per-shard engine through the scaling-table
+    machinery — the path a real pod bench would exercise."""
+    t = scaling_table(
+        "strong", (20, 20), [(1, 1), (2, 2)], stencil_impl="fused"
+    )
+    assert t["stencil_impl"] == "fused"
+    assert t["iters_consistent"] is True
+    assert all(r["converged"] for r in t["rows"])
+
+
 def test_table_runs_pallas_engine():
     t = scaling_table(
         "strong", (20, 20), [(1, 1), (2, 2)], stencil_impl="pallas"

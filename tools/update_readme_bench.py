@@ -80,8 +80,11 @@ def table_block(rec: dict, src: str) -> str:
             f"{bold}{fmt_t(row['t_solver_s'])}{bold} | {ref} | {vs} |"
         )
     for key, note in (("config2", "BASELINE config 2"),
-                      ("north_star", "north-star config")):
-        row = rec[key]
+                      ("north_star", "north-star config"),
+                      ("config4_1chip", "config-4 grid on ONE chip")):
+        row = rec.get(key)
+        if row is None:
+            continue
         M, N = row["grid"]
         lines.append(
             f"| {M}×{N} | {row['iters']} | {row['engine']} | "
