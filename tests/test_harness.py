@@ -108,6 +108,32 @@ def test_cli_unconverged_exit_code():
     assert rc == 1
 
 
+def test_phase_timer_decomposition_sums_to_total():
+    """SURVEY §4's benchmark smoke: the named phase accumulators must
+    decompose the wall clock — their sum matches an outer total timer
+    (the stage4 init/solver/finalize split's defining invariant), and
+    re-entering a phase accumulates rather than overwrites."""
+    import time as _time
+
+    from poisson_ellipse_tpu.utils.timing import PhaseTimer
+
+    t = PhaseTimer()
+    t0 = _time.perf_counter()
+    with t.phase("init"):
+        _time.sleep(0.02)
+    with t.phase("solver"):
+        _time.sleep(0.03)
+    with t.phase("solver"):
+        _time.sleep(0.01)
+    total = _time.perf_counter() - t0
+    assert set(t.totals) == {"init", "solver"}
+    assert t.totals["solver"] > t.totals["init"]
+    phase_sum = sum(t.totals.values())
+    # phases cover everything but the negligible inter-phase gaps
+    assert 0.9 * phase_sum <= total <= phase_sum + 0.05
+    assert "T_solver" in t.report()
+
+
 def test_profile_single_phases():
     phases = profile_single(Problem(M=32, N=32), jnp.float64, reps=5)
     assert set(phases) == {"stencil", "dot", "precond", "update", "halo"}

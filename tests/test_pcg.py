@@ -97,6 +97,34 @@ def test_l2_error_decreases_under_refinement():
     assert e80 < e20
 
 
+def test_energy_error_monotonically_decreases():
+    """SURVEY §4's 'PCG residual monotonicity' property, stated in the
+    quantity CG actually guarantees: the A-norm (energy) of the error
+    e_k = w_k − w* decreases strictly every iteration (the plain
+    residual norm is NOT monotone in CG and would be a wrong assert).
+    w* comes from a dense solve of the independently assembled interior
+    operator; iterates come from the resumable init_state/advance
+    stepper, whose chunking is bit-identical to a straight run."""
+    from poisson_ellipse_tpu.solver.pcg import advance, init_state
+
+    from tests.test_ops import dense_operator
+
+    problem = Problem(M=20, N=20)
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    A = dense_operator(problem, a, b)
+    M, N = problem.M, problem.N
+    w_star = np.linalg.solve(A, np.asarray(rhs)[1:M, 1:N].ravel())
+
+    state = init_state(problem, a, b, rhs)
+    energies = []
+    for k in range(1, 15):
+        state = advance(problem, a, b, rhs, state, limit=k)
+        e = np.asarray(state[1])[1:M, 1:N].ravel() - w_star
+        energies.append(float(e @ (A @ e)))
+    assert all(b < a for a, b in zip(energies, energies[1:])), energies
+    assert energies[-1] < 1e-3 * energies[0]
+
+
 def test_float32_path_converges():
     problem = Problem(M=40, N=40, delta=1e-4)
     result = solve(problem, jnp.float32)
