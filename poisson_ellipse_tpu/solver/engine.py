@@ -1,8 +1,8 @@
-"""Engine selection: one entry point over the four single-chip solvers.
+"""Engine selection: one entry point over the single-chip solver engines.
 
 The reference's ``main`` always runs its fastest implementation — stage4
 launches every CUDA kernel each iteration (``poisson_mpi_cuda2.cu:985-1038``,
-``:846-939``). The TPU framework has four single-chip engines with different
+``:846-939``). The TPU framework has five single-chip engines with different
 capacity/perf envelopes; this module is the policy that picks the fastest
 one that fits, so every product entry point (bench, CLI, harness) gets the
 best path by default:

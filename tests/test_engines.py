@@ -1,8 +1,8 @@
-"""The single-chip engine suite: fused / resident / streamed vs the XLA path.
+"""The single-chip engine suite: fused/resident/streamed/xl vs the XLA path.
 
 The reference's cross-implementation correctness oracle is iteration-count
 invariance across its five implementations (SURVEY §4.2: the same grid
-converges in the same number of PCG iterations in every stage). The four
+converges in the same number of PCG iterations in every stage). The
 TPU engines are held to the same standard — identical iteration counts and
 matching solutions on the oracle grids — plus capacity-gate and selection-
 policy checks. Pallas kernels run in interpret mode on the CPU backend
@@ -87,6 +87,24 @@ def test_max_iter_cap(engine):
     assert int(got.iters) == 5
     assert not bool(got.converged)
     assert not bool(got.breakdown)
+
+
+def test_bf16_path_converges_on_every_engine():
+    """bf16 is an advertised dtype on every Pallas engine and the XLA
+    path: with a bf16-reachable threshold each converges to an L2 error
+    in the same decade as the converged f32/f64 result at this grid
+    (~3.7e-3), and iteration counts stay within bf16-rounding slack of
+    the XLA path (exact invariance is an f32/f64 contract only)."""
+    problem = Problem(M=40, N=40, delta=1e-4)
+    ref = solve_xla(problem, jnp.bfloat16)
+    assert bool(ref.converged)
+    from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
+
+    for name, fn in {**ENGINES, "xla": solve_xla}.items():
+        got = fn(problem, jnp.bfloat16)
+        assert bool(got.converged), name
+        assert abs(int(got.iters) - int(ref.iters)) <= 3, name
+        assert float(l2_error_vs_analytic(problem, got.w)) < 1e-2, name
 
 
 @pytest.mark.parametrize("dtype", ["f64"])
