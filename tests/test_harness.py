@@ -263,6 +263,63 @@ def test_cli_threads_sweep_requires_native_mode(capsys):
     assert "requires --mode native" in capsys.readouterr().err
 
 
+def test_readme_bench_generator(tmp_path):
+    """tools/update_readme_bench.py regenerates exactly the marker
+    blocks from a bench artifact (driver format), leaves surrounding
+    text untouched, and rejects artifacts predating the
+    machine-readable rows."""
+    import importlib.util
+    import os
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "urb",
+        os.path.join(
+            os.path.dirname(__file__), "..", "tools", "update_readme_bench.py"
+        ),
+    )
+    urb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(urb)
+
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "intro\n<!-- bench:headline -->\nOLD\n<!-- /bench:headline -->\n"
+        "mid\n<!-- bench:table -->\nOLD\n<!-- /bench:table -->\noutro\n"
+    )
+    row = {
+        "grid": [800, 1200], "t_solver_s": 0.008, "iters": 989,
+        "converged": True, "engine": "resident", "l2_error": 2e-4,
+        "ref_p100_s": 0.83, "vs_p100": 103.75,
+    }
+    artifact = tmp_path / "BENCH_r99.json"
+    artifact.write_text(json.dumps({"parsed": {
+        "metric": "m", "value": 0.008, "unit": "s", "vs_baseline": 103.75,
+        "valid": True, "grids": [row],
+        "config2": {**row, "grid": [1024, 1024]},
+        "north_star": {**row, "grid": [4096, 4096], "engine": "xl"},
+        "eps_sweep": [
+            {"eps": 1e-2, "iters": 921, "converged": True,
+             "t_solver_s": 0.01, "l2_error": 2e-4},
+            {"eps": 1e-6, "iters": 921, "converged": True,
+             "t_solver_s": 0.01, "l2_error": 2e-4},
+        ],
+        "f64": {**row},
+    }}))
+    summary = urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "OLD" not in text
+    assert "103.75×" in text and "| 800×1200 |" in text
+    assert text.startswith("intro\n") and text.rstrip().endswith("outro")
+    assert "BENCH_r99.json" in summary
+    # config4_1chip absent (older artifact shape): tolerated, no row
+    assert "config-4" not in text
+    # pre-machine-readable artifact is rejected with a pointer
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"parsed": {"value": 1}}))
+    with pytest.raises(SystemExit, match="machine-readable"):
+        urb.regenerate(str(readme), str(legacy))
+
+
 def test_bench_f64_row_oracle():
     import importlib.util
     import os

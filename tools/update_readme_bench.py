@@ -122,18 +122,25 @@ def splice(text: str, marker: str, replacement: str) -> str:
     return pattern.sub(f"{begin}\n{replacement}\n{end}", text)
 
 
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    rec, src = load_artifact(argv[0] if argv else None)
-    with open(README) as f:
+def regenerate(readme_path: str, artifact_path: str | None) -> str:
+    """Rewrite the marker blocks in ``readme_path``; returns a summary."""
+    rec, src = load_artifact(artifact_path)
+    with open(readme_path) as f:
         text = f.read()
     text = splice(text, "headline", headline_block(rec, src))
     text = splice(text, "table", table_block(rec, src))
-    with open(README, "w") as f:
+    with open(readme_path, "w") as f:
         f.write(text)
-    print(f"README.md regenerated from {src}: headline "
-          f"{rec['value']} s / {rec['vs_baseline']}x, "
-          f"{len(rec['grids'])} grid rows")
+    return (
+        f"{os.path.basename(readme_path)} regenerated from {src}: headline "
+        f"{rec['value']} s / {rec['vs_baseline']}x, "
+        f"{len(rec['grids'])} grid rows"
+    )
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    print(regenerate(README, argv[0] if argv else None))
     return 0
 
 
