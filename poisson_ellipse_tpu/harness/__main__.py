@@ -91,8 +91,9 @@ def main(argv=None) -> int:
         "--engine",
         choices=ENGINES,
         default="auto",
-        help="solver engine. Single-device: auto picks the fastest that "
-        "fits (resident -> streamed -> xla); fused is the two-kernel "
+        help="solver engine. Single-device: auto picks the fastest whose "
+        "capacity regime applies (resident -> streamed -> xl; f64 takes "
+        "xla); fused is the two-kernel "
         "HBM iteration, pallas the per-op stencil kernel. Sharded mode: "
         "xla (default), pallas (the per-shard stencil kernel), or fused "
         "(the two-kernel per-shard iteration, f32/bf16)",

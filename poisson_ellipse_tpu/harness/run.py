@@ -197,7 +197,8 @@ def run_once(
                       reference's stage0 chrono wraps its whole solve());
            "auto" — sharded iff >1 device or an explicit mesh is requested.
     engine: single-device solver engine (``solver.engine.ENGINES``) —
-           "auto" picks the fastest that fits (resident → streamed → xla).
+           "auto" picks the fastest whose capacity regime applies
+           (resident → streamed → xl; f64 takes xla).
     repeat/batch: timing protocol. For single mode with batch>1, each of
     the ``repeat`` measurements times one plain dispatch and one chained
     dispatch of ``batch`` data-dependent solves, and T_solver is the

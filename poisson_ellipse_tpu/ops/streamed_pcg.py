@@ -187,9 +187,11 @@ def fits_streamed(problem: Problem, dtype=jnp.float32, device=None) -> bool:
     minimum double-buffered stream buffers fit the VMEM budget (scaled
     to ``device``'s capacity).
 
-    The state itself cannot be streamed (it is read and written every
-    pass of every iteration), so grids past this gate — e.g. the 4097²
-    node grid, whose state alone is ~201 MB — need the sharded path.
+    The state itself cannot be streamed by THIS kernel (it is read and
+    written every pass of every iteration), so grids past this gate —
+    e.g. the 4097² node grid, whose state alone is ~201 MB — take the
+    xl engine (``ops.xl_pcg``, which streams state too) or the sharded
+    path.
     """
     return StreamPlan(problem, dtype, device=device).fits
 
@@ -575,7 +577,8 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
         raise ValueError(
             f"grid {problem.M}x{problem.N}: PCG state (w, r, p) alone "
             "exceeds the VMEM budget — the streamed engine cannot hold "
-            "it on-chip; use the XLA path or the sharded solver"
+            "it on-chip; use the xl engine (auto's pick there) or the "
+            "sharded solver"
         )
     g1p, g2p, tm = plan.g1p, plan.g2p, plan.tm
     args = streamed_operand_set(problem, dtype, g1p, g2p)
