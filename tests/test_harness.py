@@ -197,12 +197,16 @@ def test_run_once_checkpointed_single(tmp_path):
     assert report.iters == 26 and report.converged
 
 
-def test_run_once_checkpoint_rejects_vmem_engines(tmp_path):
+@pytest.mark.parametrize("engine", ["resident", "streamed", "xl", "fused"])
+def test_run_once_checkpoint_rejects_whole_kernel_engines(tmp_path, engine):
+    """Checkpointing persists the XLA-loop PCG carry; the whole-solve
+    kernel engines (whose state lives in VMEM scratch / kernel-private
+    HBM) must be rejected with the xla-or-pallas pointer."""
     with pytest.raises(ValueError, match="xla or pallas"):
         run_once(
             Problem(M=20, N=20),
             mode="single",
-            engine="resident",
+            engine=engine,
             checkpoint_dir=str(tmp_path / "ck"),
         )
 
