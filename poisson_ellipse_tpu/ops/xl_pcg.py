@@ -78,7 +78,12 @@ _TM_CANDIDATES = (64, 96, 128, 256)
 
 
 class XLPlan:
-    """Tiling of the XL solve (no residency choices: everything streams)."""
+    """Tiling of the XL solve (no residency choices: everything streams).
+
+    ``dtype`` is accepted for interface parity with ``StreamPlan`` but
+    does not influence the tiling: with no residency budget to fill,
+    the tile choice is itemsize-independent (the ~16 tile buffers sit
+    far below VMEM at every candidate size)."""
 
     def __init__(self, problem: Problem, dtype, tm: int | None = None):
         g1, g2 = problem.node_shape

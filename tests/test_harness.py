@@ -324,6 +324,31 @@ def test_readme_bench_generator(tmp_path):
         urb.regenerate(str(readme), str(legacy))
 
 
+def test_bench_eps_sweep_solver_reuse_is_exact():
+    """bench.py's eps-sweep reuses ONE jitted XLA solver across eps
+    values (eps reaches the solve only through the assembled operands).
+    Guard that assumption: a solver built for one eps, fed another eps's
+    operands, must reproduce the fresh per-problem solve exactly."""
+    from poisson_ellipse_tpu.ops import assembly as asm
+    from poisson_ellipse_tpu.solver.engine import build_solver
+    from poisson_ellipse_tpu.solver.pcg import solve as solve_xla
+
+    p_a = Problem(M=24, N=24, eps=1e-2)
+    p_b = Problem(M=24, N=24, eps=1e-5)
+    reused, _, _ = build_solver(p_a, "xla", jnp.float32)
+    fresh, _, _ = build_solver(p_b, "xla", jnp.float32)
+    args_b = asm.assemble(p_b, jnp.float32)
+    got = reused(*args_b)
+    ref = fresh(*args_b)
+    assert bool(got.converged)
+    assert int(got.iters) == int(ref.iters)
+    # also iteration-identical to the independent solve() entry point
+    assert int(got.iters) == int(solve_xla(p_b, jnp.float32).iters)
+    import numpy as np
+
+    np.testing.assert_array_equal(np.asarray(got.w), np.asarray(ref.w))
+
+
 def test_bench_f64_row_oracle():
     import importlib.util
     import os
