@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from poisson_ellipse_tpu.harness.__main__ import main as cli_main
 from poisson_ellipse_tpu.harness.run import _chain_solver, run_once
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.ops.fused_pcg import interior_normalized, solve_fused
@@ -224,6 +225,39 @@ def test_select_engine_scales_with_device_vmem(monkeypatch):
     assert select_engine(
         Problem(M=800, N=1200), device=_Fake("mystery")
     ) == "resident"
+
+
+def test_vmem_capacity_table_and_scaling():
+    """utils.device directly: known kinds hit the table, unknown kinds
+    (including the CPU devices the suite runs on) fall back to the
+    measured 128 MiB part — so a budget scales by exactly 1.0 there —
+    and scaled_vmem_budget is proportional for table entries."""
+    from poisson_ellipse_tpu.utils.device import (
+        scaled_vmem_budget,
+        vmem_capacity_bytes,
+    )
+
+    class _Fake:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    mib = 1024 * 1024
+    assert vmem_capacity_bytes(_Fake("TPU v5 lite")) == 128 * mib
+    assert vmem_capacity_bytes(_Fake("not-a-tpu")) == 128 * mib
+    assert scaled_vmem_budget(114 * mib, _Fake("unknown")) == 114 * mib
+    # the suite's default (CPU) device takes the fallback too
+    assert scaled_vmem_budget(125 * mib) == 125 * mib
+
+
+def test_cli_engine_xl(capsys):
+    """--engine xl through the CLI surface (interpret mode on CPU)."""
+    rc = cli_main(["40", "40", "--mode", "single", "--engine", "xl", "--json"])
+    assert rc == 0
+    import json as _json
+
+    rec = _json.loads(capsys.readouterr().out.strip())
+    assert rec["engine"] == "xl" and rec["iters"] == 50
+    assert rec["converged"] is True
 
 
 def test_xl_plan_tile_policy_and_forced_tiles():
