@@ -108,6 +108,22 @@ def test_cli_unconverged_exit_code():
     assert rc == 1
 
 
+def test_readme_python_surfaces_importable():
+    """Every import the README's Python examples advertise must exist —
+    the public API surface the docs promise is pinned here so it cannot
+    silently drift from the documentation."""
+    from poisson_ellipse_tpu import Problem as _P, solve as _s  # noqa: F401
+    from poisson_ellipse_tpu.parallel import solve_sharded  # noqa: F401
+    from poisson_ellipse_tpu.parallel.multihost import (  # noqa: F401
+        global_mesh,
+        initialize_multihost,
+        process_info,
+        shutdown_multihost,
+    )
+    from poisson_ellipse_tpu.runtime import solve_native  # noqa: F401
+    from poisson_ellipse_tpu.solver import solve_with_checkpoints  # noqa: F401
+
+
 def test_phase_timer_decomposition_sums_to_total():
     """SURVEY §4's benchmark smoke: the named phase accumulators must
     decompose the wall clock — their sum matches an outer total timer
