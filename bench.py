@@ -258,6 +258,9 @@ def main() -> int:
                 "unit": "s",
                 "vs_baseline": round(baseline / headline_t, 2),
                 "valid": all_ok,
+                # chip the run measured on, so the regenerated README
+                # names the actual part instead of a hardcoded one
+                "device": jax.devices()[0].device_kind,
                 # machine-readable rows: tools/update_readme_bench.py
                 # regenerates the README's measured table from these
                 "grids": grid_rows,

@@ -28,7 +28,9 @@ from poisson_ellipse_tpu.utils.timing import PhaseTimer, fence
 
 DTYPES = {
     "f32": jnp.float32,
-    "f64": jnp.float64,
+    # deliberate f64 menu entry: resolve_dtype below flips jax_enable_x64
+    # on before this dtype is ever applied, so it cannot downcast
+    "f64": jnp.float64,  # tpulint: disable=TPU001
     "bf16": jnp.bfloat16,
 }
 

@@ -1,0 +1,244 @@
+"""tpulint — a JAX/Pallas-aware static-analysis pass for the kernel zoo.
+
+The repo carries seven PCG engine variants whose failure modes (silent
+dtype drift, traced-value branches, host syncs in hot loops, per-call
+recompilation, VMEM-overflowing Pallas tiles) the reference project
+caught by hand across five rewrites. tpulint catches them mechanically:
+
+    python -m poisson_ellipse_tpu.lint              # paths from pyproject
+    python -m poisson_ellipse_tpu.lint poisson_ellipse_tpu/ops --statistics
+
+Rules are TPU001–TPU006 (see :mod:`.rules`); any finding can be waived
+in place with a trailing or preceding-line comment::
+
+    x = jnp.zeros(n, jnp.float64)  # tpulint: disable=TPU001
+
+Configuration lives in ``pyproject.toml`` under ``[tool.tpulint]`` and
+is shared by this CLI and the pytest gate (``tests/test_lint_clean.py``),
+so "lints clean" means the same thing on a laptop and in CI.
+
+Public API: :func:`load_config`, :func:`lint_paths`, :func:`lint_file`,
+:func:`lint_source` (the test harness entry), and :data:`RULES`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+import re
+from typing import Iterable, Optional
+
+from poisson_ellipse_tpu.lint.report import Finding, ParseError
+from poisson_ellipse_tpu.lint.rules import RULES, LintConfig
+from poisson_ellipse_tpu.lint.visitor import Module
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "ParseError",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "load_config",
+]
+
+
+# -- configuration ----------------------------------------------------------
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Minimal TOML reader for the ``[tool.tpulint]`` table.
+
+    This interpreter ships neither ``tomllib`` (3.11+) nor ``tomli``, and
+    the repo vendors nothing, so the loader falls back to a subset
+    parser: ``[section]`` headers, ``key = value`` with string / integer /
+    flat string-array values, ``#`` comments. Exactly the shapes the
+    tpulint table uses; anything fancier should go through ``tomllib``.
+    """
+    data: dict = {}
+    section = data
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = data
+            for part in line[1:-1].strip().strip('"').split("."):
+                section = section.setdefault(part.strip().strip('"'), {})
+            continue
+        if "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        key = key.strip().strip('"')
+        value = value.strip()
+        if value.startswith("[") and value.endswith("]"):
+            items = re.findall(r'"((?:[^"\\]|\\.)*)"', value)
+            section[key] = list(items)
+        elif value.startswith('"') and value.endswith('"'):
+            section[key] = value[1:-1]
+        elif value in ("true", "false"):
+            section[key] = value == "true"
+        else:
+            try:
+                section[key] = int(value)
+            except ValueError:
+                section[key] = value
+    return data
+
+
+def _read_pyproject(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        import tomllib  # Python 3.11+
+
+        return tomllib.loads(text)
+    except ImportError:
+        return _parse_toml_subset(text)
+
+
+def load_config(root: Optional[str] = None) -> LintConfig:
+    """The shared CLI/pytest-gate configuration.
+
+    ``root`` is the directory holding ``pyproject.toml``; defaults to the
+    repo root two levels above this package. A missing file or table
+    yields the built-in defaults, so the linter works on any checkout.
+    """
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    pyproject = os.path.join(root, "pyproject.toml")
+    table: dict = {}
+    if os.path.exists(pyproject):
+        table = _read_pyproject(pyproject).get("tool", {}).get("tpulint", {})
+    cfg = LintConfig()
+    select = table.get("select")
+    ignore = table.get("ignore", [])
+    unknown = (
+        frozenset(c.upper() for c in (select or []))
+        | frozenset(c.upper() for c in ignore)
+    ) - RULES.keys()
+    if unknown:
+        # mirror the CLI's check: a typo'd code in pyproject must not
+        # silently weaken (select) or widen (ignore) the gate
+        raise SystemExit(
+            f"[tool.tpulint] names unknown rule code(s): "
+            f"{', '.join(sorted(unknown))} (known: {', '.join(sorted(RULES))})"
+        )
+    return dataclasses.replace(
+        cfg,
+        paths=tuple(table.get("paths", cfg.paths)),
+        exclude=tuple(table.get("exclude", cfg.exclude)),
+        select=frozenset(select) if select else None,
+        ignore=frozenset(ignore),
+        per_path_ignores={
+            pat: tuple(codes)
+            for pat, codes in table.get("per-path-ignores", {}).items()
+        },
+        min_donate_params=table.get(
+            "min-donate-params", cfg.min_donate_params
+        ),
+        jit_factory_patterns=tuple(
+            table.get("jit-factory-patterns", cfg.jit_factory_patterns)
+        ),
+        assumed_itemsize=table.get("assumed-itemsize", cfg.assumed_itemsize),
+    )
+
+
+# -- running ----------------------------------------------------------------
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def _path_ignored_codes(path: str, config: LintConfig) -> frozenset[str]:
+    codes: set[str] = set()
+    norm = _norm(path)
+    for pattern, pat_codes in config.per_path_ignores.items():
+        # patterns are repo-relative; the leading-`*/` retry makes them
+        # match when the runner was handed absolute paths (pytest gate)
+        if (
+            fnmatch.fnmatch(norm, pattern)
+            or fnmatch.fnmatch(norm, f"*/{pattern}")
+            or fnmatch.fnmatch(os.path.basename(norm), pattern)
+        ):
+            codes.update(c.upper() for c in pat_codes)
+    return frozenset(codes)
+
+
+def _active_rules(config: LintConfig, extra_ignore: frozenset[str] = frozenset()):
+    for code, rule in sorted(RULES.items()):
+        if config.select is not None and code not in config.select:
+            continue
+        if code in config.ignore or code in extra_ignore:
+            continue
+        yield rule
+
+
+def lint_source(
+    source: str,
+    path: str = "<snippet>",
+    config: Optional[LintConfig] = None,
+) -> list[Finding]:
+    """Lint a source string — the fixture-snippet entry the tests use."""
+    config = config or LintConfig()
+    module = Module(path, source)
+    findings: list[Finding] = []
+    for rule in _active_rules(config, _path_ignored_codes(path, config)):
+        for f in rule.check(module, config):
+            if not module.suppressed(f.line, f.code):
+                findings.append(f)
+    return sorted(findings)
+
+
+def lint_file(path: str, config: Optional[LintConfig] = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path=path, config=config)
+
+
+def _iter_py_files(paths: Iterable[str], config: LintConfig):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__", ".git")
+            )
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                if any(
+                    fnmatch.fnmatch(_norm(full), pat)
+                    for pat in config.exclude
+                ):
+                    continue
+                yield full
+
+
+def lint_paths(
+    paths: Iterable[str],
+    config: Optional[LintConfig] = None,
+) -> tuple[list[Finding], list[ParseError]]:
+    """Lint files/trees; returns (findings, parse errors), both sorted."""
+    config = config or LintConfig()
+    paths = list(paths)
+    findings: list[Finding] = []
+    errors: list[ParseError] = []
+    for path in paths:
+        if not os.path.exists(path):
+            # a typo'd path must not read as "lints clean"
+            errors.append(ParseError(path=path, message="no such file or directory"))
+    for path in _iter_py_files(paths, config):
+        try:
+            findings.extend(lint_file(path, config))
+        except (SyntaxError, ValueError, UnicodeDecodeError) as e:
+            errors.append(ParseError(path=path, message=str(e)))
+        except OSError as e:
+            errors.append(ParseError(path=path, message=str(e)))
+    return sorted(findings), sorted(errors, key=lambda e: e.path)

@@ -1,0 +1,87 @@
+"""CLI: ``python -m poisson_ellipse_tpu.lint [paths ...]``.
+
+Exit status: 0 clean, 1 findings, 2 unparseable input or bad usage —
+the same contract as the pytest gate, so CI needs no extra wiring.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+from poisson_ellipse_tpu.lint import (
+    RULES,
+    lint_paths,
+    load_config,
+)
+from poisson_ellipse_tpu.lint.report import exit_code, render_report
+
+
+def _codes(value: str) -> frozenset[str]:
+    codes = frozenset(c.strip().upper() for c in value.split(",") if c.strip())
+    unknown = codes - RULES.keys()
+    if unknown:
+        # a typo'd --select must not turn the gate into a silent no-op
+        raise argparse.ArgumentTypeError(
+            f"unknown rule code(s): {', '.join(sorted(unknown))} "
+            f"(known: {', '.join(sorted(RULES))})"
+        )
+    return codes
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.lint",
+        description="TPU-aware static analysis for the kernel zoo "
+        "(rules TPU001-TPU006; suppress with `# tpulint: disable=CODE`).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories (default: [tool.tpulint] paths)",
+    )
+    parser.add_argument(
+        "--select", type=_codes, default=None,
+        help="comma-separated codes to run exclusively (e.g. TPU002,TPU005)",
+    )
+    parser.add_argument(
+        "--ignore", type=_codes, default=None,
+        help="comma-separated codes to skip",
+    )
+    parser.add_argument(
+        "--statistics", action="store_true",
+        help="append a per-code finding tally",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, rule in sorted(RULES.items()):
+            print(f"{code} {rule.name:18s} {rule.summary}")
+        return 0
+
+    config = load_config()
+    if args.select is not None:
+        config = dataclasses.replace(config, select=args.select)
+    if args.ignore is not None:
+        config = dataclasses.replace(
+            config, ignore=config.ignore | args.ignore
+        )
+    paths = args.paths or list(config.paths)
+    findings, errors = lint_paths(paths, config)
+    for err in errors:
+        print(err.render(), file=sys.stderr)
+    if findings:
+        print(render_report(findings, statistics=args.statistics))
+    rc = exit_code(findings, errors)
+    if rc == 0:
+        print(f"tpulint: {len(list(RULES))} rules, 0 findings — clean")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Finding container and report rendering for ``tpulint``.
+
+One finding = one (path, line, col, code, message). Rendering follows the
+``flake8`` convention (``path:line:col: CODE message``) so editors and CI
+annotators that already parse that shape pick tpulint up for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Iterable, Sequence
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """A single rule violation at a source location."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ParseError:
+    """A file the linter could not parse (reported, exit code 2)."""
+
+    path: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}: cannot parse: {self.message}"
+
+
+def render_report(
+    findings: Sequence[Finding],
+    *,
+    statistics: bool = False,
+) -> str:
+    """The human-facing report: one line per finding, sorted by location,
+    plus an optional per-code tally (``--statistics``)."""
+    lines = [f.render() for f in sorted(findings)]
+    if statistics and findings:
+        lines.append("")
+        for code, n in sorted(Counter(f.code for f in findings).items()):
+            lines.append(f"{code}: {n}")
+    return "\n".join(lines)
+
+
+def exit_code(findings: Iterable[Finding], errors: Iterable[ParseError]) -> int:
+    """0 clean, 1 findings, 2 unparseable input (trumps findings)."""
+    if list(errors):
+        return 2
+    return 1 if list(findings) else 0

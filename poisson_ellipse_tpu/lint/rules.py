@@ -1,0 +1,576 @@
+"""The tpulint rule registry: TPU001–TPU006.
+
+Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
+Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
+Suppression (``# tpulint: disable=CODE``) and select/ignore filtering are
+applied by the runner, not here. Rules are deliberately conservative:
+when a shape, dtype or callee cannot be resolved statically they stay
+silent — a lint gate that cries wolf gets deleted from CI.
+
+| code   | name               | hazard                                        |
+|--------|--------------------|-----------------------------------------------|
+| TPU001 | f64-literal        | float64 dtype silently downcast w/o x64       |
+| TPU002 | traced-branch      | Python if/while on a traced value             |
+| TPU003 | host-sync          | host sync reachable from a jitted hot loop    |
+| TPU004 | missing-donation   | jit with large-array params, no donate_argnums|
+| TPU005 | pallas-tile        | BlockSpec off the (8, 128) grid / VMEM budget |
+| TPU006 | jit-per-call       | jax.jit rebuilt per loop step / per call      |
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import functools
+import os
+from typing import Callable, Iterator, Optional
+
+from poisson_ellipse_tpu.lint.report import Finding
+from poisson_ellipse_tpu.lint.visitor import Module, TracedFn
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Knobs shared by the CLI and the pytest gate (``[tool.tpulint]``)."""
+
+    paths: tuple[str, ...] = ("poisson_ellipse_tpu",)
+    exclude: tuple[str, ...] = ()
+    select: Optional[frozenset[str]] = None
+    ignore: frozenset[str] = frozenset()
+    per_path_ignores: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+    # TPU004: only jit sites whose callee has at least this many
+    # non-static positional params are assumed to carry "large" operands.
+    min_donate_params: int = 3
+    # TPU006: functions matching these names are jit *factories* (build
+    # once, call many — the repo-wide contract); construction inside them
+    # is not a per-call hazard.
+    jit_factory_patterns: tuple[str, ...] = ("build_*", "make_*")
+    # TPU005: itemsize assumed for tiles whose dtype cannot be resolved.
+    assumed_itemsize: int = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+    check: Callable[[Module, LintConfig], Iterator[Finding]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(code: str, name: str, summary: str):
+    def deco(fn):
+        RULES[code] = Rule(code, name, summary, fn)
+        return fn
+
+    return deco
+
+
+def _finding(module: Module, node: ast.AST, code: str, message: str) -> Finding:
+    return Finding(
+        path=module.path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1,
+        code=code,
+        message=message,
+    )
+
+
+# --------------------------------------------------------------------------
+# TPU001 — float64 literals that silently downcast under disabled x64
+# --------------------------------------------------------------------------
+
+_F64_NAMES = frozenset(
+    {"jax.numpy.float64", "jax.numpy.double", "numpy.float64", "numpy.double"}
+)
+_F64_STRINGS = frozenset({"float64", "double", "f8", "<f8"})
+# positional index of the dtype parameter for common jnp constructors
+_DTYPE_POS = {
+    "array": 1, "asarray": 1, "zeros": 1, "ones": 1, "empty": 1, "full": 2,
+}
+
+
+def _is_f64_dtype_expr(module: Module, node: ast.AST) -> bool:
+    q = module.qualname(node)
+    if q == "float" or q in _F64_NAMES:
+        return True
+    return isinstance(node, ast.Constant) and node.value in _F64_STRINGS
+
+
+@rule(
+    "TPU001",
+    "f64-literal",
+    "float64/`float` dtypes under jnp silently downcast to float32 when "
+    "jax_enable_x64 is off",
+)
+def check_f64_literal(module: Module, config: LintConfig) -> Iterator[Finding]:
+    flagged: set[tuple[int, int]] = set()
+
+    def flag(node, msg):
+        key = (node.lineno, node.col_offset)
+        if key not in flagged:
+            flagged.add(key)
+            yield _finding(module, node, "TPU001", msg)
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            q = module.qualname(node.func) or ""
+            if not q.startswith("jax.numpy."):
+                continue
+            dtype_expr = None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dtype_expr = kw.value
+            pos = _DTYPE_POS.get(q.rsplit(".", 1)[1])
+            if dtype_expr is None and pos is not None and pos < len(node.args):
+                dtype_expr = node.args[pos]
+            if dtype_expr is not None and _is_f64_dtype_expr(module, dtype_expr):
+                yield from flag(
+                    dtype_expr,
+                    f"`{q.removeprefix('jax.')}` built with a float64/"
+                    "`float` dtype: silently becomes float32 under disabled "
+                    "x64 — spell the narrow dtype you mean, or gate on "
+                    "`jax.config.jax_enable_x64`",
+                )
+        elif isinstance(node, (ast.Attribute, ast.Name)):
+            if module.qualname(node) in ("jax.numpy.float64", "jax.numpy.double"):
+                parent = Module.parent(node)
+                if isinstance(parent, ast.Attribute):
+                    continue  # the inner part of a longer dotted name
+                yield from flag(
+                    node,
+                    "`jnp.float64` is float32 under disabled x64 — this "
+                    "reference silently changes meaning with the flag",
+                )
+
+
+# --------------------------------------------------------------------------
+# TPU002 — Python control flow on traced values
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "TPU002",
+    "traced-branch",
+    "Python `if`/`while` on a traced value inside a jit/loop-body function",
+)
+def check_traced_branch(module: Module, config: LintConfig) -> Iterator[Finding]:
+    for fn in module.traced_fns:
+        tainted = module.tainted_names(fn)
+        if not tainted:
+            continue
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.If, ast.While)) and module.expr_mentions(
+                node.test, tainted
+            ):
+                kw = "while" if isinstance(node, ast.While) else "if"
+                yield _finding(
+                    module,
+                    node,
+                    "TPU002",
+                    f"Python `{kw}` on a traced value in a {fn.kind} "
+                    "function: fails at trace time or silently bakes one "
+                    "branch into the compile — use `jax.lax.cond`/"
+                    "`jnp.where` (or mark the argument static)",
+                )
+
+
+# --------------------------------------------------------------------------
+# TPU003 — host syncs reachable from jitted hot loops
+# --------------------------------------------------------------------------
+
+_HOST_SYNC_METHODS = frozenset({"block_until_ready", "item", "tolist"})
+_HOST_SYNC_CALLS = frozenset(
+    {"jax.block_until_ready", "jax.device_get", "numpy.asarray", "numpy.array"}
+)
+_HOST_CAST_BUILTINS = frozenset({"float", "int", "bool"})
+
+
+def _host_sync_findings(
+    module: Module,
+    fn_node: ast.AST,
+    tainted: set[str],
+    origin: str,
+    seen: set[tuple[int, frozenset[str]]],
+    depth: int = 0,
+) -> Iterator[Finding]:
+    key = (id(fn_node), frozenset(tainted))
+    if key in seen or depth > 8:
+        return
+    seen.add(key)
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        # method-style syncs: x.block_until_ready(), x.item(), x.tolist()
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_SYNC_METHODS
+            and module.qualname(node.func) not in _HOST_SYNC_CALLS
+        ):
+            yield _finding(
+                module,
+                node,
+                "TPU003",
+                f"`.{node.func.attr}()` is a host sync reachable from "
+                f"{origin}: the loop stalls on a device round-trip every "
+                "dispatch — hoist it out of the hot path",
+            )
+            continue
+        q = module.qualname(node.func)
+        if q in _HOST_SYNC_CALLS:
+            needs_taint = q.startswith("numpy.")
+            if not needs_taint or (
+                node.args and module.expr_mentions(node.args[0], tainted)
+            ):
+                yield _finding(
+                    module,
+                    node,
+                    "TPU003",
+                    f"`{q}` forces a device→host transfer reachable from "
+                    f"{origin} — keep the hot loop device-resident",
+                )
+            continue
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _HOST_CAST_BUILTINS
+            and q == node.func.id  # not shadowed by an import
+            and node.args
+            and module.expr_mentions(node.args[0], tainted)
+        ):
+            yield _finding(
+                module,
+                node,
+                "TPU003",
+                f"`{node.func.id}()` on a traced value reachable from "
+                f"{origin}: blocks on the device to produce a Python "
+                "scalar — keep the value on device or move the cast out "
+                "of the traced path",
+            )
+            continue
+        # shallow same-module reachability: follow calls to local defs,
+        # mapping argument taint onto their parameters
+        if isinstance(node.func, ast.Name):
+            callee = module.functions.get(node.func.id)
+            if callee is not None and callee is not fn_node:
+                params = [p.arg for p in callee.args.args]
+                callee_tainted = {
+                    params[i]
+                    for i, arg in enumerate(node.args)
+                    if i < len(params) and module.expr_mentions(arg, tainted)
+                }
+                yield from _host_sync_findings(
+                    module, callee, callee_tainted, origin, seen, depth + 1
+                )
+
+
+@rule(
+    "TPU003",
+    "host-sync",
+    "host-sync call (`.block_until_ready()`, `float(x)`, `np.asarray`) "
+    "reachable from a jitted hot loop",
+)
+def check_host_sync(module: Module, config: LintConfig) -> Iterator[Finding]:
+    seen: set[tuple[int, frozenset[str]]] = set()
+    emitted: set[tuple[int, int]] = set()
+    for fn in module.traced_fns:
+        name = getattr(fn.node, "name", "<lambda>")
+        origin = f"{fn.kind} `{name}`"
+        for f in _host_sync_findings(
+            module, fn.node, module.tainted_names(fn), origin, seen
+        ):
+            if (f.line, f.col) not in emitted:
+                emitted.add((f.line, f.col))
+                yield f
+
+
+# --------------------------------------------------------------------------
+# TPU004 — jit call sites with large-array params missing donate_argnums
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "TPU004",
+    "missing-donation",
+    "jax.jit over a many-array-param callable without donate_argnums/"
+    "donate_argnames",
+)
+def check_missing_donation(module: Module, config: LintConfig) -> Iterator[Finding]:
+    for node in ast.walk(module.tree):
+        target = None
+        jit_call = None
+        if isinstance(node, ast.Call):
+            wrapped = module.jit_construction(node)
+            if wrapped is None:
+                continue
+            jit_call, target = node, module.resolve_callable(wrapped)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                head = dec.func if isinstance(dec, ast.Call) else dec
+                if module.is_jit_name(head) or (
+                    isinstance(dec, ast.Call)
+                    and dec.args
+                    and module.is_jit_name(dec.args[0])
+                ):
+                    jit_call, target = (
+                        dec if isinstance(dec, ast.Call) else None
+                    ), node
+        if target is None or not hasattr(target, "args"):
+            continue
+        if target.args.vararg is not None:
+            continue  # arity unknowable
+        static = (
+            module._jit_static_params(jit_call, target)
+            if jit_call is not None
+            else frozenset()
+        )
+        n_params = len(
+            [
+                p.arg
+                for p in (
+                    list(getattr(target.args, "posonlyargs", []))
+                    + list(target.args.args)
+                )
+                if p.arg not in static and p.arg not in ("self", "cls")
+            ]
+        )
+        if n_params < config.min_donate_params:
+            continue
+        kwargs = {kw.arg for kw in jit_call.keywords} if jit_call is not None else set()
+        if kwargs & {"donate_argnums", "donate_argnames"}:
+            continue
+        site = node if isinstance(node, ast.Call) else (jit_call or node)
+        name = getattr(target, "name", "<lambda>")
+        yield _finding(
+            module,
+            site,
+            "TPU004",
+            f"jax.jit over `{name}` ({n_params} array-like params) without "
+            "donate_argnums/donate_argnames: every dispatch keeps all "
+            "inputs alive alongside the outputs — donate consumed operands, "
+            "or suppress with a note when callers reuse them",
+        )
+
+
+# --------------------------------------------------------------------------
+# TPU005 — Pallas BlockSpec tiles off the (8, 128) grid / over VMEM budget
+# --------------------------------------------------------------------------
+
+_SUBLANE, _LANE = 8, 128
+_ITEMSIZE_BY_DTYPE = {
+    "float64": 8, "int64": 8, "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "int8": 1, "uint8": 1,
+    "bool_": 1, "bool": 1, "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+_MIN_VMEM_FALLBACK = 128 * 1024 * 1024
+
+
+@functools.lru_cache(maxsize=1)
+def _min_vmem_capacity() -> int:
+    """Smallest per-core VMEM across the supported parts, read statically
+    from ``utils/device.py``'s ``_VMEM_CAPACITY`` table (no jax import:
+    the linter must run identically with no accelerator runtime)."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "utils", "device.py"
+    )
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        namespace: dict[str, object] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if isinstance(target, ast.Name) and target.id in (
+                    "_MIB",
+                    "_VMEM_CAPACITY",
+                ):
+                    code = compile(ast.Expression(node.value), path, "eval")
+                    namespace[target.id] = eval(code, {}, dict(namespace))
+        table = namespace.get("_VMEM_CAPACITY")
+        if isinstance(table, dict) and table:
+            return min(int(v) for v in table.values())
+    except (OSError, SyntaxError, ValueError, NameError, TypeError):
+        pass
+    return _MIN_VMEM_FALLBACK
+
+
+def _itemsize_of(module: Module, node: Optional[ast.AST], fallback: int) -> int:
+    if node is None:
+        return fallback
+    q = module.qualname(node) or ""
+    return _ITEMSIZE_BY_DTYPE.get(q.rsplit(".", 1)[-1], fallback)
+
+
+def _blockspec_shape(module: Module, call: ast.Call):
+    """(shape tuple of int-or-None, memory_space qualname) of a BlockSpec."""
+    shape_expr = call.args[0] if call.args else None
+    memspace = None
+    for kw in call.keywords:
+        if kw.arg == "block_shape":
+            shape_expr = kw.value
+        elif kw.arg == "memory_space":
+            memspace = module.qualname(kw.value) or ""
+    if not isinstance(shape_expr, (ast.Tuple, ast.List)):
+        return None, memspace
+    dims = tuple(
+        e.value if isinstance(e, ast.Constant) and isinstance(e.value, int) else None
+        for e in shape_expr.elts
+    )
+    return dims, memspace
+
+
+def _is_vmem_space(memspace: Optional[str]) -> bool:
+    return memspace is None or memspace.endswith(".VMEM")
+
+
+@rule(
+    "TPU005",
+    "pallas-tile",
+    "Pallas BlockSpec tile off the (8, 128) sublane/lane grid, or a "
+    "kernel VMEM working set over the smallest supported part's budget",
+)
+def check_pallas_tile(module: Module, config: LintConfig) -> Iterator[Finding]:
+    min_vmem = _min_vmem_capacity()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = module.qualname(node.func) or ""
+        if q.endswith(".BlockSpec") and q.startswith("jax.experimental.pallas"):
+            dims, memspace = _blockspec_shape(module, node)
+            if dims is None or not _is_vmem_space(memspace):
+                continue
+            checks = []
+            if len(dims) >= 1 and dims[-1] is not None:
+                checks.append((dims[-1], _LANE, "lane (minor)"))
+            if len(dims) >= 2 and dims[-2] is not None:
+                checks.append((dims[-2], _SUBLANE, "sublane (second-minor)"))
+            for value, mult, which in checks:
+                if value % mult != 0:
+                    yield _finding(
+                        module,
+                        node,
+                        "TPU005",
+                        f"BlockSpec {which} dim {value} is not a multiple "
+                        f"of {mult}: Mosaic pads every tile to the "
+                        f"({_SUBLANE}, {_LANE}) grid, silently wasting "
+                        "VMEM and lanes — pick an aligned tile",
+                    )
+        elif q.endswith(".pallas_call"):
+            total = 0
+            for kw in node.keywords:
+                if kw.arg != "scratch_shapes":
+                    continue
+                entries = (
+                    kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else []
+                )
+                for entry in entries:
+                    if not isinstance(entry, ast.Call):
+                        continue
+                    eq = module.qualname(entry.func) or ""
+                    if not eq.endswith(".VMEM"):
+                        continue
+                    shape = entry.args[0] if entry.args else None
+                    if not isinstance(shape, (ast.Tuple, ast.List)):
+                        continue
+                    dims = [
+                        e.value
+                        if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                        else None
+                        for e in shape.elts
+                    ]
+                    if any(d is None for d in dims):
+                        total = None  # unknowable statically: stay silent
+                        break
+                    n = 1
+                    for d in dims:
+                        n *= d
+                    itemsize = _itemsize_of(
+                        module,
+                        entry.args[1] if len(entry.args) > 1 else None,
+                        config.assumed_itemsize,
+                    )
+                    total += n * itemsize
+                if total is None:
+                    break
+            if total and total > min_vmem:
+                yield _finding(
+                    module,
+                    node,
+                    "TPU005",
+                    f"pallas_call VMEM scratch working set ≈{total // 1024 // 1024} "
+                    f"MiB exceeds the smallest supported part's "
+                    f"{min_vmem // 1024 // 1024} MiB budget "
+                    "(utils/device.py capability table) — tile smaller or "
+                    "gate the kernel on `utils.device.vmem_capacity_bytes`",
+                )
+
+
+# --------------------------------------------------------------------------
+# TPU006 — jax.jit constructed per loop step / per call
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "TPU006",
+    "jit-per-call",
+    "jax.jit constructed inside a Python loop or per-call closure "
+    "(recompilation hazard)",
+)
+def check_jit_per_call(module: Module, config: LintConfig) -> Iterator[Finding]:
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if module.jit_construction(node) is None:
+            continue
+        in_loop = any(
+            isinstance(anc, (ast.For, ast.While, ast.AsyncFor))
+            for anc in module.ancestors(node)
+        )
+        if in_loop:
+            yield _finding(
+                module,
+                node,
+                "TPU006",
+                "jax.jit constructed inside a Python loop: every iteration "
+                "builds a fresh callable with an empty dispatch cache — "
+                "hoist the jit out of the loop",
+            )
+            continue
+        parent = Module.parent(node)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            yield _finding(
+                module,
+                node,
+                "TPU006",
+                "jax.jit(...)(...) constructs and calls in one expression: "
+                "the traced cache dies with the expression, so every "
+                "evaluation recompiles — bind the jitted callable once",
+            )
+            continue
+        enclosing = module.enclosing_function(node)
+        if enclosing is None:
+            continue  # module scope: constructed once at import
+        name = getattr(enclosing, "name", "<lambda>")
+        if any(
+            fnmatch.fnmatch(name, pat) for pat in config.jit_factory_patterns
+        ):
+            continue
+        stmt = module.nearest_statement(node)
+        if isinstance(stmt, ast.Return):
+            continue  # a factory by shape: the jit object is the product
+        yield _finding(
+            module,
+            node,
+            "TPU006",
+            f"jax.jit constructed per call of `{name}` (neither returned "
+            "nor in a recognised factory): callers re-entering this "
+            "function retrace from scratch — hoist the jit, return it, or "
+            "suppress with a note when single-shot construction is the "
+            "point",
+        )

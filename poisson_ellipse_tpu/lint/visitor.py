@@ -1,0 +1,333 @@
+"""AST context shared by the tpulint rules.
+
+One :class:`Module` per source file carries everything a rule needs and
+nothing JAX-runtime: import-alias resolution (``jnp.float64`` and
+``jax.numpy.float64`` are the same symbol to a rule), suppression
+comments, parent links, the set of *traced functions* (jit-decorated
+defs, ``jax.jit(...)`` call sites, ``lax.while_loop``/``scan``/
+``fori_loop``/``cond`` bodies), and a shallow traced-value taint over a
+function's parameters. Everything is computed from ``ast`` alone — the
+linter never imports the code it analyses, so it runs (and fails) the
+same with or without an accelerator attached.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterator, Optional
+
+# Attribute reads that produce Python-static facts even on a traced
+# array; a branch on `x.ndim` is trace-safe, a branch on `x` is not.
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "itemsize"})
+
+# Builtins whose call result is static regardless of argument taint.
+STATIC_CALLS = frozenset({"len", "isinstance", "type", "hasattr", "getattr"})
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# lax loop/control constructs and which of their arguments are traced
+# callables (positional index); keyword names accepted as well.
+TRACED_CALLABLE_ARGS = {
+    "jax.lax.while_loop": ((0, "cond_fun"), (1, "body_fun")),
+    "jax.lax.fori_loop": ((2, "body_fun"),),
+    "jax.lax.scan": ((0, "f"),),
+    "jax.lax.cond": ((1, "true_fun"), (2, "false_fun")),
+    "jax.lax.switch": (),  # branches arrive as a list; handled specially
+}
+
+
+def _parse_suppressions(source: str) -> dict[int, frozenset[str]]:
+    """line number -> codes disabled on that line.
+
+    A suppression comment covers its own line; when the line holds
+    nothing but the comment, it also covers the next line (so long
+    expressions can carry the annotation above rather than trailing).
+    ``disable=all`` disables every rule.
+    """
+    out: dict[int, set[str]] = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        codes = {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+        out.setdefault(lineno, set()).update(codes)
+        if text.strip().startswith("#"):  # standalone: covers the line below
+            out.setdefault(lineno + 1, set()).update(codes)
+    return {k: frozenset(v) for k, v in out.items()}
+
+
+def _import_aliases(tree: ast.AST) -> dict[str, str]:
+    """local name -> canonical dotted prefix (``jnp`` -> ``jax.numpy``)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+@dataclasses.dataclass
+class TracedFn:
+    """A function whose body is traced by JAX (so Python control flow on
+    its array arguments is a staging hazard, not ordinary code)."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    kind: str  # "jit-def" | "jit-call" | "loop-body"
+    static_params: frozenset[str] = frozenset()
+
+    @property
+    def params(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in getattr(a, "posonlyargs", [])]
+        names += [p.arg for p in a.args]
+        names += [p.arg for p in a.kwonlyargs]
+        return [n for n in names if n not in self.static_params]
+
+
+class Module:
+    """Parsed source + the derived facts every rule reads."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source)
+        self.suppressions = _parse_suppressions(source)
+        self.aliases = _import_aliases(self.tree)
+        self._attach_parents()
+        # every def in the file, by (possibly shadowed) name — shallow
+        # same-module call resolution for the reachability rules
+        self.functions: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+        self.traced_fns = list(self._find_traced_fns())
+
+    # -- structure ----------------------------------------------------------
+
+    def _attach_parents(self) -> None:
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._tpulint_parent = parent  # type: ignore[attr-defined]
+
+    @staticmethod
+    def parent(node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_tpulint_parent", None)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return anc
+        return None
+
+    def nearest_statement(self, node: ast.AST) -> Optional[ast.stmt]:
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parent(cur)
+        return cur
+
+    # -- names --------------------------------------------------------------
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expression, through import aliases
+        (``jnp.zeros`` -> ``jax.numpy.zeros``); None when not a name."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.qualname(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    def suppressed(self, line: int, code: str) -> bool:
+        codes = self.suppressions.get(line, frozenset())
+        return code.upper() in codes or "ALL" in codes
+
+    # -- jit discovery ------------------------------------------------------
+
+    def is_jit_name(self, node: ast.AST) -> bool:
+        return self.qualname(node) in ("jax.jit", "jax.pjit", "jit")
+
+    def jit_construction(self, call: ast.Call) -> Optional[ast.AST]:
+        """If ``call`` constructs a jitted callable, the wrapped callee
+        expression; otherwise None. Handles ``jax.jit(f)`` and
+        ``functools.partial(jax.jit, ...)(f)``-free ``partial(jax.jit,
+        f)`` spellings; a ``jax.shard_map``/``shard_map`` wrapper is
+        looked through (the jit still closes over its callable)."""
+        fn: Optional[ast.AST] = None
+        if self.is_jit_name(call.func) and call.args:
+            fn = call.args[0]
+        elif (
+            self.qualname(call.func) in ("functools.partial", "partial")
+            and len(call.args) >= 2
+            and self.is_jit_name(call.args[0])
+        ):
+            fn = call.args[1]
+        if fn is None:
+            return None
+        if isinstance(fn, ast.Call):
+            q = self.qualname(fn.func) or ""
+            if q.endswith("shard_map") and (fn.args or fn.keywords):
+                inner = fn.args[0] if fn.args else fn.keywords[0].value
+                return inner
+        return fn
+
+    def resolve_callable(self, node: ast.AST) -> Optional[ast.AST]:
+        """Lambda/FunctionDef behind a callable expression, or None."""
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Name):
+            return self.functions.get(node.id)
+        return None
+
+    @staticmethod
+    def _literal_int_tuple(node: ast.AST) -> Optional[tuple[int, ...]]:
+        if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in node.elts
+        ):
+            return tuple(e.value for e in node.elts)
+        return None
+
+    def _jit_static_params(self, call_or_dec: ast.AST, fn: ast.AST) -> frozenset[str]:
+        """Parameter names made static by literal static_argnums/names."""
+        if not isinstance(call_or_dec, ast.Call):
+            return frozenset()
+        args = fn.args if hasattr(fn, "args") else None
+        if args is None:
+            return frozenset()
+        pos = [p.arg for p in getattr(args, "posonlyargs", [])] + [
+            p.arg for p in args.args
+        ]
+        static: set[str] = set()
+        for kw in call_or_dec.keywords:
+            if kw.arg == "static_argnums":
+                nums = self._literal_int_tuple(kw.value)
+                if nums is None and isinstance(kw.value, ast.Constant):
+                    nums = (kw.value.value,) if isinstance(kw.value.value, int) else None
+                for i in nums or ():
+                    if 0 <= i < len(pos):
+                        static.add(pos[i])
+            elif kw.arg == "static_argnames":
+                vals = kw.value
+                elts = vals.elts if isinstance(vals, (ast.Tuple, ast.List)) else [vals]
+                for e in elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        static.add(e.value)
+        return frozenset(static)
+
+    def _find_traced_fns(self) -> Iterator[TracedFn]:
+        seen: set[int] = set()
+
+        def emit(node, kind, static=frozenset()):
+            if node is not None and id(node) not in seen and hasattr(node, "args"):
+                seen.add(id(node))
+                yield TracedFn(node, kind, static)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if self.is_jit_name(target) or (
+                        isinstance(dec, ast.Call)
+                        and self.qualname(dec.func)
+                        in ("functools.partial", "partial")
+                        and dec.args
+                        and self.is_jit_name(dec.args[0])
+                    ):
+                        yield from emit(
+                            node, "jit-def", self._jit_static_params(dec, node)
+                        )
+            elif isinstance(node, ast.Call):
+                wrapped = self.jit_construction(node)
+                if wrapped is not None:
+                    fn = self.resolve_callable(wrapped)
+                    if fn is not None:
+                        yield from emit(
+                            fn, "jit-call", self._jit_static_params(node, fn)
+                        )
+                    continue
+                q = self.qualname(node.func)
+                spec = TRACED_CALLABLE_ARGS.get(q or "")
+                if spec is None:
+                    continue
+                if q == "jax.lax.switch":
+                    branches = node.args[1] if len(node.args) > 1 else None
+                    elts = (
+                        branches.elts
+                        if isinstance(branches, (ast.Tuple, ast.List))
+                        else []
+                    )
+                    for e in elts:
+                        yield from emit(self.resolve_callable(e), "loop-body")
+                    continue
+                for idx, kwname in spec:
+                    arg = None
+                    if idx < len(node.args):
+                        arg = node.args[idx]
+                    else:
+                        for kw in node.keywords:
+                            if kw.arg == kwname:
+                                arg = kw.value
+                    fn = self.resolve_callable(arg) if arg is not None else None
+                    yield from emit(fn, "loop-body")
+
+    # -- taint --------------------------------------------------------------
+
+    def expr_mentions(self, node: ast.AST, names: set[str]) -> bool:
+        """Does ``node`` read any name in ``names`` in a way that yields a
+        traced value? Reads of static facts (``x.shape``, ``len(x)``,
+        ``isinstance(x, ...)``) do not count."""
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Name) and sub.id in names):
+                continue
+            parent = self.parent(sub)
+            if (
+                isinstance(parent, ast.Attribute)
+                and parent.value is sub
+                and parent.attr in STATIC_ATTRS
+            ):
+                continue
+            if (
+                isinstance(parent, ast.Call)
+                and sub in parent.args
+                and isinstance(parent.func, ast.Name)
+                and parent.func.id in STATIC_CALLS
+            ):
+                continue
+            return True
+        return False
+
+    def tainted_names(self, fn: TracedFn) -> set[str]:
+        """Parameters of ``fn`` plus names derived from them by simple
+        assignment/tuple-unpacking, in statement order (shallow forward
+        taint — no fixpoint; loops rarely launder a trace)."""
+        tainted: set[str] = set(fn.params)
+        body = fn.node.body
+        stmts = body if isinstance(body, list) else []
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) and self.expr_mentions(
+                    node.value, tainted
+                ):
+                    for target in node.targets:
+                        for leaf in ast.walk(target):
+                            if isinstance(leaf, ast.Name):
+                                tainted.add(leaf.id)
+                elif isinstance(node, ast.AugAssign) and self.expr_mentions(
+                    node.value, tainted
+                ):
+                    if isinstance(node.target, ast.Name):
+                        tainted.add(node.target.id)
+        return tainted

@@ -495,6 +495,9 @@ def build_fused_solver(problem: Problem, dtype=jnp.float32, interpret=None):
             problem, kern, (an, as_, bw, be, d_p, dinv_p), r0, g1, g2
         )
 
+    # no donation: build-once-call-many — callers re-feed these operands
+    # every dispatch (bench --repeat protocol)
+    # tpulint: disable=TPU004
     return jax.jit(solver), args
 
 

@@ -463,6 +463,9 @@ def build_xl_solver(problem: Problem, dtype=jnp.float32, interpret=None,
             breakdown=flags[1].astype(bool),
         )
 
+    # no donation: build-once-call-many — callers re-feed these operands
+    # every dispatch (bench --repeat protocol)
+    # tpulint: disable=TPU004
     return jax.jit(solver), args
 
 

@@ -367,14 +367,18 @@ def build_sharded_stepper(
             problem, stencil, pdot, d, state, dtype, limit=limit
         )
 
-    init_mapped = jax.jit(jax.shard_map(
+    # no donation on either stepper half: a/b are re-fed every chunk, and
+    # the carry cannot be donated because solver.checkpoint hands it to
+    # orbax's *async* save — the serializer may still be reading the old
+    # buffers while the next advance runs
+    init_mapped = jax.jit(jax.shard_map(  # tpulint: disable=TPU004
         init_shard,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=state_specs,
         check_vma=check_vma,
     ))
-    advance_mapped = jax.jit(jax.shard_map(
+    advance_mapped = jax.jit(jax.shard_map(  # tpulint: disable=TPU004
         advance_shard,
         mesh=mesh,
         in_specs=(spec, spec, state_specs, scalar),

@@ -99,8 +99,11 @@ class CheckpointingSolver:
                 problem, self._a, self._b, self._rhs
             )
             # one compiled advance reused for every chunk: the bound rides
-            # in as a traced scalar
-            self._advance = jax.jit(
+            # in as a traced scalar. Built once per solver *instance* by
+            # design (the operands are captured at __init__), so the
+            # per-call-closure hazard does not apply; the carry is not
+            # donated because _save hands it to orbax's async serializer.
+            self._advance = jax.jit(  # tpulint: disable=TPU006
                 lambda state, limit: advance(
                     problem,
                     self._a,

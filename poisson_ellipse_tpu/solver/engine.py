@@ -146,7 +146,9 @@ def build_solver(
 
         a, b, rhs = assembly.assemble(problem, dtype)
         stencil = engine
-        solver = jax.jit(
+        # no donation: the build-once-call-many contract re-feeds these
+        # operands on every dispatch (bench --repeat, chained solves)
+        solver = jax.jit(  # tpulint: disable=TPU004
             lambda a, b, rhs: pcg(problem, a, b, rhs, stencil=stencil)
         )
         args = (a, b, rhs)

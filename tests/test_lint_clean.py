@@ -1,0 +1,37 @@
+"""The lint gate: the package must lint clean on every PR.
+
+This is the CI wiring the ISSUE asks for — tier-1 already runs pytest,
+so a pytest-visible assertion over ``lint_paths`` makes tpulint a gate
+with no extra infrastructure. It uses the same ``[tool.tpulint]`` config
+as the CLI, so ``python -m poisson_ellipse_tpu.lint`` reproducing a CI
+failure locally is exact, not approximate.
+"""
+
+from __future__ import annotations
+
+import os
+
+from poisson_ellipse_tpu.lint import lint_paths, load_config
+from poisson_ellipse_tpu.lint.report import render_report
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_lints_clean():
+    config = load_config(REPO_ROOT)
+    paths = [os.path.join(REPO_ROOT, p) for p in config.paths]
+    findings, errors = lint_paths(paths, config)
+    assert not errors, "\n".join(e.render() for e in errors)
+    assert not findings, (
+        "tpulint findings (fix, or annotate with "
+        "`# tpulint: disable=CODE` plus a justification):\n"
+        + render_report(findings, statistics=True)
+    )
+
+
+def test_config_comes_from_pyproject():
+    # the gate and the CLI must share one config: spot-check that the
+    # pyproject table actually loaded rather than silently defaulting
+    config = load_config(REPO_ROOT)
+    assert config.paths == ("poisson_ellipse_tpu",)
+    assert "poisson_ellipse_tpu/runtime/*" in config.per_path_ignores

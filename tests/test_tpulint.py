@@ -1,0 +1,398 @@
+"""tpulint rule tests: one positive and one negative fixture per rule.
+
+Each fixture is a small source snippet fed through ``lint_source`` — the
+same path the CLI and the CI gate take, minus the filesystem. Positives
+assert the rule fires with its stable code; negatives assert the nearby
+trace-safe idiom stays silent (a lint gate that cries wolf gets deleted
+from CI, so the negatives are as load-bearing as the positives).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from poisson_ellipse_tpu.lint import LintConfig, RULES, lint_source
+from poisson_ellipse_tpu.lint.report import Finding, render_report
+
+
+def codes_of(source: str, **cfg) -> list[str]:
+    config = LintConfig(**cfg) if cfg else None
+    return [f.code for f in lint_source(textwrap.dedent(source), config=config)]
+
+
+# -- registry shape ---------------------------------------------------------
+
+
+def test_registry_has_all_six_rules():
+    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 7)]
+    for code, rule in RULES.items():
+        assert rule.code == code
+        assert rule.name and rule.summary
+
+
+# -- TPU001: f64 literals ---------------------------------------------------
+
+
+def test_tpu001_positive_dtype_kwarg_and_positional():
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+        x = jnp.zeros((4, 4), dtype=np.float64)
+        y = jnp.asarray([1.0], float)
+        z = jnp.array([1.0], dtype="float64")
+    """
+    assert codes_of(src) == ["TPU001", "TPU001", "TPU001"]
+
+
+def test_tpu001_positive_bare_jnp_float64_reference():
+    src = """
+        import jax.numpy as jnp
+        DTYPES = {"f64": jnp.float64}
+    """
+    assert codes_of(src) == ["TPU001"]
+
+
+def test_tpu001_negative_narrow_and_host_numpy():
+    # explicit narrow dtypes and *host* numpy float64 are both fine: only
+    # jnp is subject to the silent x64 downcast
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+        a = jnp.zeros((4, 4), dtype=jnp.float32)
+        b = np.zeros((4, 4), np.float64)
+        c = np.arange(5, dtype=np.float64)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu001_suppression_comment():
+    src = """
+        import jax.numpy as jnp
+        x = jnp.zeros(3, dtype=jnp.float64)  # tpulint: disable=TPU001
+        # tpulint: disable=TPU001
+        y = jnp.ones(3, dtype=jnp.float64)
+    """
+    assert codes_of(src) == []
+
+
+# -- TPU002: Python control flow on traced values ---------------------------
+
+
+def test_tpu002_positive_if_in_jit_def():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """
+    assert "TPU002" in codes_of(src)
+
+
+def test_tpu002_positive_while_in_loop_body():
+    src = """
+        from jax import lax
+
+        def solve(state):
+            def body(carry):
+                r = carry
+                while r > 1e-6:
+                    r = r * 0.5
+                return r
+            return lax.while_loop(lambda c: c > 0, body, state)
+    """
+    assert "TPU002" in codes_of(src)
+
+
+def test_tpu002_negative_static_branches():
+    # branches on shapes/closure config are trace-time static, and
+    # static_argnums-marked params are Python values
+    src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def f(x, mode):
+            if mode == "fast":
+                return x * 2
+            if x.ndim == 2:
+                return x.T
+            return x
+    """
+    assert codes_of(src) == []
+
+
+# -- TPU003: host syncs reachable from jitted functions ---------------------
+
+
+def test_tpu003_positive_direct_and_reachable():
+    src = """
+        import jax
+        import numpy as np
+
+        def helper(v):
+            return float(v) * 2.0
+
+        @jax.jit
+        def hot(x):
+            x.block_until_ready()
+            y = np.asarray(x)
+            return helper(x) + y
+    """
+    codes = codes_of(src)
+    assert codes.count("TPU003") == 3  # method sync, np.asarray, float-in-callee
+
+
+def test_tpu003_negative_host_side_fencing():
+    # the same calls OUTSIDE traced functions are the normal host idiom
+    src = """
+        import jax
+        import numpy as np
+
+        def bench(solver, args):
+            out = solver(*args)
+            jax.block_until_ready(out)
+            return float(np.asarray(out)[0])
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu003_negative_float_of_static():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            scale = float(1e-3)
+            return x * scale
+    """
+    assert codes_of(src) == []
+
+
+# -- TPU004: jit without donate_argnums -------------------------------------
+
+
+def test_tpu004_positive_many_param_jit_call():
+    src = """
+        import jax
+
+        def build(problem):
+            def solver(a, b, rhs):
+                return a + b + rhs
+            return jax.jit(solver)
+    """
+    assert codes_of(src) == ["TPU004"]
+
+
+def test_tpu004_positive_decorated_def():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(w, r, p):
+            return w + r + p
+    """
+    assert codes_of(src) == ["TPU004"]
+
+
+def test_tpu004_negative_donated_or_small():
+    src = """
+        import jax
+
+        def build():
+            def solver(a, b, rhs):
+                return a + b + rhs
+            def tiny(x):
+                return x
+            return jax.jit(solver, donate_argnums=(2,)), jax.jit(tiny)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu004_static_argnums_shrink_arity():
+    # 3 positional params but one is static: below the default threshold
+    src = """
+        import jax
+
+        def build():
+            def solver(a, b, mode):
+                return a + b
+            return jax.jit(solver, static_argnums=(2,))
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu004_threshold_configurable():
+    src = """
+        import jax
+
+        def build():
+            def solver(a, b):
+                return a + b
+            return jax.jit(solver)
+    """
+    assert codes_of(src) == []
+    assert codes_of(src, min_donate_params=2) == ["TPU004"]
+
+
+# -- TPU005: Pallas tile alignment / VMEM budget ----------------------------
+
+
+def test_tpu005_positive_misaligned_blockspec():
+    src = """
+        from jax.experimental import pallas as pl
+        spec = pl.BlockSpec((7, 100), lambda i: (i, 0))
+    """
+    codes = codes_of(src)
+    assert codes == ["TPU005", "TPU005"]  # lane AND sublane misaligned
+
+
+def test_tpu005_positive_vmem_overflow():
+    # 5 × (8192, 1024) f32 scratch = 160 MiB > the smallest part's budget
+    src = """
+        import jax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        import jax.numpy as jnp
+
+        out = pl.pallas_call(
+            lambda o: None,
+            out_shape=jax.ShapeDtypeStruct((8, 128), "float32"),
+            scratch_shapes=[
+                pltpu.VMEM((8192, 1024), jnp.float32),
+                pltpu.VMEM((8192, 1024), jnp.float32),
+                pltpu.VMEM((8192, 1024), jnp.float32),
+                pltpu.VMEM((8192, 1024), jnp.float32),
+                pltpu.VMEM((8192, 1024), jnp.float32),
+            ],
+        )
+    """
+    assert "TPU005" in codes_of(src)
+
+
+def test_tpu005_negative_aligned_dynamic_and_smem():
+    # aligned literals, dynamic tiles, and SMEM scalar specs all pass
+    src = """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def make(tm, g2):
+            a = pl.BlockSpec((128, 256), lambda i: (i, 0))
+            b = pl.BlockSpec((tm, g2), lambda i: (i, 0))
+            c = pl.BlockSpec(memory_space=pltpu.SMEM)
+            d = pl.BlockSpec((1, 1), memory_space=pltpu.SMEM)
+            return a, b, c, d
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu005_capability_table_is_read_statically():
+    from poisson_ellipse_tpu.lint.rules import _min_vmem_capacity
+    from poisson_ellipse_tpu.utils.device import _VMEM_CAPACITY
+
+    # the static AST read of utils/device.py must agree with the runtime
+    # table — the whole point of cross-checking against one source
+    assert _min_vmem_capacity() == min(_VMEM_CAPACITY.values())
+
+
+# -- TPU006: per-call jit construction --------------------------------------
+
+
+def test_tpu006_positive_jit_in_loop():
+    src = """
+        import jax
+
+        def sweep(fns, x):
+            outs = []
+            for fn in fns:
+                outs.append(jax.jit(fn)(x))
+            return outs
+    """
+    codes = codes_of(src)
+    assert "TPU006" in codes
+
+
+def test_tpu006_positive_construct_and_call():
+    src = """
+        import jax
+
+        def run(f, x):
+            return jax.jit(f)(x)
+    """
+    assert "TPU006" in codes_of(src)
+
+
+def test_tpu006_negative_module_scope_and_factories():
+    src = """
+        import jax
+
+        step = jax.jit(lambda x: x + 1)
+
+        def build_solver(f):
+            solver = jax.jit(f)
+            return solver
+
+        def stepper(f):
+            return jax.jit(f)
+    """
+    assert codes_of(src) == []
+
+
+# -- plumbing: suppression scope, CLI, report -------------------------------
+
+
+def test_suppression_is_per_code_not_blanket():
+    src = """
+        import jax
+
+        def run(f, x):
+            return jax.jit(f)(x)  # tpulint: disable=TPU004
+    """
+    # suppressing an unrelated code must not hide the TPU006 finding
+    assert "TPU006" in codes_of(src)
+
+
+def test_unknown_codes_are_rejected_not_silently_selected():
+    # --select TPU999 must not turn the gate into a passing no-op
+    import argparse
+
+    from poisson_ellipse_tpu.lint.__main__ import _codes
+
+    assert _codes("tpu001,TPU006") == frozenset({"TPU001", "TPU006"})
+    with pytest.raises(argparse.ArgumentTypeError, match="TPU999"):
+        _codes("TPU999")
+
+
+def test_render_report_is_flake8_shaped():
+    f = Finding(path="pkg/mod.py", line=3, col=5, code="TPU002", message="m")
+    assert f.render() == "pkg/mod.py:3:5: TPU002 m"
+    out = render_report([f, f], statistics=True)
+    assert out.endswith("TPU002: 2")
+
+
+@pytest.mark.slow
+def test_cli_exits_nonzero_on_fixture(tmp_path):
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\nx = jnp.zeros(3, dtype=jnp.float64)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "poisson_ellipse_tpu.lint", str(bad)],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=repo_root,
+    )
+    assert proc.returncode == 1
+    assert "TPU001" in proc.stdout

@@ -1,0 +1,162 @@
+"""tools/update_readme_bench.py: the README generator, under test.
+
+The README's performance blocks are generated, so the generator is
+load-bearing documentation infrastructure: a silent regression here
+re-introduces exactly the hand-typed-numbers drift the tool exists to
+prevent. Covered: artifact selection (round-number order, not
+lexicographic), partial-artifact rejection with the curated message,
+headline derivation from the artifact's own rows (no hardcoded grid/
+chip/baseline), and marker-splice round-tripping (idempotence).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+_TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "update_readme_bench.py",
+)
+_spec = importlib.util.spec_from_file_location("update_readme_bench", _TOOL)
+urb = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(urb)
+
+
+def make_artifact(**overrides) -> dict:
+    rec = {
+        "metric": "T_solver 100x200 (42 PCG iters to 1e-6), f32, 1 chip",
+        "value": 0.5,
+        "unit": "s",
+        "vs_baseline": 4.0,
+        "valid": True,
+        "device": "TPU v6e",
+        "grids": [
+            {"grid": [100, 200], "t_solver_s": 0.5, "iters": 42,
+             "converged": True, "engine": "resident",
+             "ref_p100_s": 2.0, "vs_p100": 4.0},
+            {"grid": [400, 600], "t_solver_s": 1.25, "iters": 99,
+             "converged": True, "engine": "xl",
+             "ref_p100_s": None, "vs_p100": None},
+        ],
+        "config2": {"grid": [64, 64], "t_solver_s": 0.01, "iters": 7,
+                    "converged": True, "engine": "resident"},
+        "eps_sweep": [
+            {"eps": 1e-2, "iters": 7, "converged": True, "t_solver_s": 0.01},
+            {"eps": 1e-6, "iters": 9, "converged": True, "t_solver_s": 0.01},
+        ],
+        "f64": {"grid": [100, 200], "t_solver_s": 3.0, "iters": 42,
+                "converged": True, "engine": "xla"},
+    }
+    rec.update(overrides)
+    return rec
+
+
+README_STUB = """# stub
+
+<!-- bench:headline -->
+stale headline
+<!-- /bench:headline -->
+
+prose between the blocks
+
+<!-- bench:table -->
+stale table
+<!-- /bench:table -->
+"""
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text(README_STUB)
+    artifact = tmp_path / "BENCH_r02.json"
+    artifact.write_text(json.dumps({"parsed": make_artifact()}))
+    return tmp_path, readme, artifact
+
+
+def test_regenerate_derives_everything_from_artifact(workspace):
+    tmp, readme, artifact = workspace
+    summary = urb.regenerate(str(readme), str(artifact), root=str(tmp))
+    text = readme.read_text()
+    assert "BENCH_r02.json" in summary
+    head = text.split("<!-- bench:headline -->")[1].split(
+        "<!-- /bench:headline -->"
+    )[0]
+    # grid, iters, δ, chip and baseline all come from the artifact rows
+    assert "**0.5000 s** for 100×200" in head
+    assert "42 iterations to δ=1e-6" in head
+    assert "TPU v6e" in head
+    assert "single-P100 2.0 s" in head
+    assert "**4×**" in head
+    # the headline row is bolded in the table; non-reference rows dashed
+    assert "| 100×200 | 42 | resident | **0.5000 s** | 2.0 s | **4×** |" in text
+    assert "| 400×600 | 99 | xl | 1.25 s | — | — |" in text
+    # prose outside the markers untouched
+    assert "prose between the blocks" in text
+
+
+def test_regenerate_is_idempotent(workspace):
+    tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact), root=str(tmp))
+    once = readme.read_text()
+    urb.regenerate(str(readme), str(artifact), root=str(tmp))
+    assert readme.read_text() == once
+
+
+def test_device_falls_back_to_measured_part(workspace):
+    tmp, readme, artifact = workspace
+    rec = make_artifact()
+    del rec["device"]
+    artifact.write_text(json.dumps(rec))  # raw bench.py line form
+    urb.regenerate(str(readme), str(artifact), root=str(tmp))
+    assert urb.MEASURED_DEVICE in readme.read_text()
+
+
+@pytest.mark.parametrize("missing", ["grids", "config2", "eps_sweep", "f64"])
+def test_partial_artifact_gets_curated_error(workspace, missing):
+    tmp, readme, artifact = workspace
+    rec = make_artifact()
+    del rec[missing]
+    artifact.write_text(json.dumps(rec))
+    with pytest.raises(SystemExit) as exc:
+        urb.regenerate(str(readme), str(artifact), root=str(tmp))
+    assert missing in str(exc.value)
+    assert "re-run" in str(exc.value)
+
+
+def test_empty_rows_get_the_curated_error_too(workspace):
+    # an aborted driver run can serialize "grids": [] — as unusable as
+    # an absent key, and it must not surface as a raw IndexError
+    tmp, readme, artifact = workspace
+    artifact.write_text(json.dumps(make_artifact(grids=[])))
+    with pytest.raises(SystemExit) as exc:
+        urb.regenerate(str(readme), str(artifact), root=str(tmp))
+    assert "grids" in str(exc.value)
+
+
+def test_newest_artifact_by_round_number_not_lexicographic(tmp_path, capsys):
+    # lexicographic sort would pick r9 over r10 and r100; round-number
+    # parse must not
+    for name in ("BENCH_r9.json", "BENCH_r10.json", "BENCH_r100.json"):
+        (tmp_path / name).write_text("{}")
+        time.sleep(0.01)
+    # make the lexicographic winner also the mtime winner, so only the
+    # round-number key can produce the right answer
+    os.utime(tmp_path / "BENCH_r9.json")
+    picked = urb.newest_artifact(str(tmp_path))
+    assert os.path.basename(picked) == "BENCH_r100.json"
+    assert "BENCH_r100.json" in capsys.readouterr().out
+
+
+def test_missing_marker_is_a_curated_error(workspace):
+    tmp, readme, artifact = workspace
+    readme.write_text("# no markers here\n")
+    with pytest.raises(SystemExit) as exc:
+        urb.regenerate(str(readme), str(artifact), root=str(tmp))
+    assert "marker" in str(exc.value)

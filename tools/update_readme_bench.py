@@ -8,12 +8,16 @@ source:
     python bench.py > /tmp/bench.json   # or use the driver's BENCH_r*.json
     python tools/update_readme_bench.py [/tmp/bench.json]
 
-With no argument the newest `BENCH_r*.json` in the repo root is used.
-Both formats are accepted: the driver artifact (``{"parsed": {...}}``)
-and bench.py's raw stdout line. The tool rewrites the text between the
-``<!-- bench:... -->`` marker pairs in README.md and leaves everything
-else untouched; artifacts from before the machine-readable "grids" key
-are rejected with a pointer to re-run the bench.
+With no argument the newest `BENCH_r*.json` in the repo root is used —
+"newest" by parsed round number (mtime breaks ties), not filename sort,
+so r100 beats r99 — and the chosen file is echoed. Both formats are
+accepted: the driver artifact (``{"parsed": {...}}``) and bench.py's raw
+stdout line. Every number in the generated text (headline grid,
+iteration count, reference baseline, chip name) is derived from the
+artifact's own rows; nothing is hardcoded here. The tool rewrites the
+text between the ``<!-- bench:... -->`` marker pairs in README.md and
+leaves everything else untouched; artifacts missing any of the
+machine-readable keys are rejected with a pointer to re-run the bench.
 """
 
 from __future__ import annotations
@@ -27,20 +31,54 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 README = os.path.join(ROOT, "README.md")
 
+# every key table_block/headline_block reads; a partial artifact gets the
+# curated error below, never a bare KeyError
+REQUIRED_KEYS = ("value", "vs_baseline", "grids", "config2", "eps_sweep", "f64")
 
-def load_artifact(path: str | None) -> tuple[dict, str]:
+# chip the committed budgets/artifacts were measured on: the honest
+# fallback for artifacts that predate bench.py's "device" field
+MEASURED_DEVICE = "TPU v5e"
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _round_key(path: str) -> tuple[int, float]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    n = int(m.group(1)) if m else -1
+    return n, os.path.getmtime(path)
+
+
+def newest_artifact(root: str = ROOT) -> str:
+    """The highest-round (mtime tie-broken) BENCH_r*.json under root."""
+    rounds = glob.glob(os.path.join(root, "BENCH_r*.json"))
+    if not rounds:
+        raise SystemExit(f"no BENCH_r*.json found in {root}; pass a path")
+    picked = max(rounds, key=_round_key)
+    print(
+        f"using {os.path.basename(picked)} "
+        f"(round {_round_key(picked)[0]}, newest of {len(rounds)} artifacts)"
+    )
+    return picked
+
+
+def load_artifact(path: str | None, root: str = ROOT) -> tuple[dict, str]:
     """(parsed bench record, source label)."""
     if path is None:
-        rounds = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
-        if not rounds:
-            raise SystemExit("no BENCH_r*.json found; pass a path")
-        path = rounds[-1]
+        path = newest_artifact(root)
     with open(path) as f:
         data = json.load(f)
     rec = data.get("parsed", data)  # driver artifact vs raw bench line
-    if "grids" not in rec:
+    # empty rows are as unusable as absent ones (an aborted driver run
+    # can serialize "grids": []) — same curated error, not an IndexError
+    missing = [
+        k
+        for k in REQUIRED_KEYS
+        if k not in rec or (isinstance(rec[k], list) and not rec[k])
+    ]
+    if missing:
         raise SystemExit(
-            f"{path} predates the machine-readable bench rows; re-run "
+            f"{path} predates the machine-readable bench rows "
+            f"(missing: {', '.join(missing)}); re-run "
             "`python bench.py > out.json` and pass that file"
         )
     return rec, os.path.basename(path)
@@ -50,12 +88,43 @@ def fmt_t(t: float) -> str:
     return f"{t:.4f} s" if t < 1 else f"{t:.2f} s"
 
 
+def headline_row(rec: dict) -> dict:
+    """The grids row the headline `value` was measured on.
+
+    Matched by the timing itself (both come from the same bench run);
+    falls back to the first row carrying a reference baseline, so a
+    hand-rounded artifact still resolves to the comparable row.
+    """
+    for row in rec["grids"]:
+        if row["t_solver_s"] == rec["value"]:
+            return row
+    for row in rec["grids"]:
+        if row.get("ref_p100_s"):
+            return row
+    return rec["grids"][0]
+
+
+def _delta_of(rec: dict) -> str | None:
+    m = re.search(r"to\s+(?:δ=)?([0-9.eE+-]+)\)", rec.get("metric", ""))
+    return m.group(1) if m else None
+
+
 def headline_block(rec: dict, src: str) -> str:
-    return (
-        f"Measured headline: **{fmt_t(rec['value'])}** for 800×1200 "
-        f"(989 iterations to δ=1e-6) on one TPU v5e chip — "
+    row = headline_row(rec)
+    M, N = row["grid"]
+    delta = _delta_of(rec)
+    iters = f"{row['iters']} iterations" + (f" to δ={delta}" if delta else "")
+    device = rec.get("device", MEASURED_DEVICE)
+    ref = row.get("ref_p100_s")
+    vs = (
         f"**{rec['vs_baseline']:g}×** the reference's stage4 single-P100 "
-        f"0.83 s. (Generated from `{src}` by "
+        f"{ref} s" if ref else f"**{rec['vs_baseline']:g}×** the "
+        "reference baseline"
+    )
+    return (
+        f"Measured headline: **{fmt_t(rec['value'])}** for {M}×{N} "
+        f"({iters}) on one {device} chip — {vs}. "
+        f"(Generated from `{src}` by "
         f"`tools/update_readme_bench.py` — the same artifact as the "
         f"table below.)"
     )
@@ -70,11 +139,12 @@ def table_block(rec: dict, src: str) -> str:
         "| Grid | iters | engine | this framework | stage4 1×P100 | speedup |",
         "|---|---|---|---|---|---|",
     ]
+    bold_grid = headline_row(rec)["grid"]
     for row in rec["grids"]:
         M, N = row["grid"]
         ref = f"{row['ref_p100_s']} s" if row.get("ref_p100_s") else "—"
         vs = f"**{row['vs_p100']:g}×**" if row.get("vs_p100") else "—"
-        bold = "**" if [M, N] == [800, 1200] else ""
+        bold = "**" if row["grid"] == bold_grid else ""
         lines.append(
             f"| {M}×{N} | {row['iters']} | {row['engine']} | "
             f"{bold}{fmt_t(row['t_solver_s'])}{bold} | {ref} | {vs} |"
@@ -122,9 +192,10 @@ def splice(text: str, marker: str, replacement: str) -> str:
     return pattern.sub(f"{begin}\n{replacement}\n{end}", text)
 
 
-def regenerate(readme_path: str, artifact_path: str | None) -> str:
+def regenerate(readme_path: str, artifact_path: str | None,
+               root: str = ROOT) -> str:
     """Rewrite the marker blocks in ``readme_path``; returns a summary."""
-    rec, src = load_artifact(artifact_path)
+    rec, src = load_artifact(artifact_path, root=root)
     with open(readme_path) as f:
         text = f.read()
     text = splice(text, "headline", headline_block(rec, src))
