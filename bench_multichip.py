@@ -29,9 +29,11 @@ def main(argv=None) -> int:
     ap.add_argument("--meshes", help="comma list of PXxPY meshes, e.g. 1x1,2x2,4x4")
     ap.add_argument("--dtype", default="f32")
     ap.add_argument(
-        "--engine", choices=("xla", "pallas", "fused"), default="xla",
+        "--engine", choices=("xla", "pallas", "fused", "pipelined"),
+        default="xla",
         help="sharded engine: xla block stencil, per-shard pallas "
-        "stencil kernel, or the fused two-kernel iteration (f32/bf16)",
+        "stencil kernel, the fused two-kernel iteration (f32/bf16), or "
+        "the pipelined one-psum-per-iteration recurrence",
     )
     ap.add_argument("--repeat", type=int, default=1)
     ap.add_argument("--batch", type=int, default=1)
@@ -91,20 +93,42 @@ def main(argv=None) -> int:
 
     kinds = ("strong", "weak") if args.kind == "both" else (args.kind,)
     rc = 0
-    for kind in kinds:
+    # with the default engine, the strong series also runs the pipelined
+    # one-psum-per-iteration recurrence, so the artifact carries the
+    # 2-collectives-vs-1 comparison side by side (its iteration counts
+    # are held to ±2 of xla's, not equality — a documented reordering)
+    series = [(kind, args.engine) for kind in kinds]
+    if args.engine == "xla" and "strong" in kinds:
+        series.append(("strong", "pipelined"))
+    xla_strong_iters = None
+    for kind, engine in series:
         table = scaling_table(
             kind,
             grids[kind],
             meshes,
             dtype=args.dtype,
-            stencil_impl=args.engine,
+            stencil_impl=engine,
             repeat=args.repeat,
             batch=args.batch,
         )
         print(json.dumps(table))
-        if table["iters_consistent"] is False or not all(
-            r["converged"] for r in table["rows"]
-        ):
+        iters_ok = table["iters_consistent"] is not False
+        if kind == "strong" and engine == "xla":
+            xla_strong_iters = table["rows"][0]["iters"]
+        if engine == "pipelined" and kind == "strong":
+            # the pipelined engine's contract is ±2 of xla, never exact
+            # mesh-invariance: judge against the xla baseline when this
+            # run produced one, else against the rows' own spread
+            # (weak tables vary the grid, so per-row counts differ by
+            # design and the generic converged check is the gate)
+            iters = [r["iters"] for r in table["rows"]]
+            anchor = (
+                xla_strong_iters
+                if xla_strong_iters is not None
+                else min(iters)
+            )
+            iters_ok = all(abs(i - anchor) <= 2 for i in iters)
+        if not iters_ok or not all(r["converged"] for r in table["rows"]):
             rc = 1
     return rc
 

@@ -94,9 +94,12 @@ def main(argv=None) -> int:
         help="solver engine. Single-device: auto picks the fastest whose "
         "capacity regime applies (resident -> streamed -> xl; f64 takes "
         "xla); fused is the two-kernel "
-        "HBM iteration, pallas the per-op stencil kernel. Sharded mode: "
-        "xla (default), pallas (the per-shard stencil kernel), or fused "
-        "(the two-kernel per-shard iteration, f32/bf16)",
+        "HBM iteration, pallas the per-op stencil kernel, pipelined the "
+        "one-fused-reduction-per-iteration recurrence (pipelined-pallas: "
+        "same loop through the fused stencil+partials kernel). Sharded "
+        "mode: xla (default), pallas (the per-shard stencil kernel), "
+        "fused (the two-kernel per-shard iteration, f32/bf16), or "
+        "pipelined (one stacked psum per iteration)",
     )
     ap.add_argument(
         "--threads",

@@ -35,14 +35,19 @@ HEADLINE = ((400, 600), 546)
 
 def _row(engine: str, M: int, N: int, oracle: int) -> tuple[bool, str]:
     problem = Problem(M=M, N=N)
+    # the pipelined recurrence is a documented reordering: its contract
+    # is the oracle ±2, not equality (ops.pipelined_pcg accuracy note)
+    slack = 2 if engine.startswith("pipelined") else 0
     try:
         solver, args, resolved = build_solver(
             problem, engine, jnp.float32
         )
         result = solver(*args)
         iters = int(result.iters)
-        ok = bool(result.converged) and iters == oracle
-        note = f"iters={iters} (oracle {oracle})"
+        ok = bool(result.converged) and abs(iters - oracle) <= slack
+        note = f"iters={iters} (oracle {oracle}" + (
+            f"±{slack})" if slack else ")"
+        )
         if resolved != engine:
             note += f" [auto->{resolved}]"
     except Exception as e:  # a build/compile failure IS the finding
@@ -55,13 +60,18 @@ def _sharded_row(
 ) -> tuple[bool, str]:
     from poisson_ellipse_tpu.parallel.pcg_sharded import solve_sharded
 
+    slack = 2 if stencil_impl == "pipelined" else 0
     try:
         result = solve_sharded(
             Problem(M=M, N=N), dtype=jnp.float32, stencil_impl=stencil_impl
         )
         iters = int(result.iters)
-        ok = bool(result.converged) and iters == oracle
-        note = f"iters={iters} (oracle {oracle}) over {len(jax.devices())} device(s)"
+        ok = bool(result.converged) and abs(iters - oracle) <= slack
+        note = (
+            f"iters={iters} (oracle {oracle}"
+            + (f"±{slack})" if slack else ")")
+            + f" over {len(jax.devices())} device(s)"
+        )
     except Exception as e:
         ok, note = False, f"{type(e).__name__}: {e}"
     return ok, note
@@ -79,7 +89,7 @@ def run_acceptance(headline: bool = False, out=sys.stderr) -> bool:
             print(f"  {'ok ' if ok else 'FAIL'} {M}x{N} {engine:9s} {note}",
                   file=out)
     for (M, N), oracle in list(SMALL_ORACLES.items())[-1:]:
-        for impl in ("xla", "pallas", "fused"):
+        for impl in ("xla", "pallas", "fused", "pipelined"):
             ok, note = _sharded_row(M, N, oracle, stencil_impl=impl)
             all_ok &= ok
             print(

@@ -33,6 +33,7 @@ from jax import lax
 
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.parallel.compat import shard_map
 from poisson_ellipse_tpu.ops.reduction import grid_dot
 from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
 from poisson_ellipse_tpu.utils.timing import fence
@@ -149,7 +150,7 @@ def profile_sharded(
         # dispatch of the (t_5k - t_k) protocol, so every input outlives
         # its call by design
         return jax.jit(  # tpulint: disable=TPU004
-            jax.shard_map(
+            shard_map(
                 blk_fn,
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
@@ -214,7 +215,7 @@ def profile_sharded(
 
             # no donation: same re-fed operands as chained() above
             return jax.jit(  # tpulint: disable=TPU004
-                jax.shard_map(
+                shard_map(
                     blk_fn,
                     mesh=mesh,
                     in_specs=(spec, spec, spec, spec),

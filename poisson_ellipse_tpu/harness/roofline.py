@@ -67,6 +67,13 @@ def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
       fused — K1 reads z, p, 5 coefficient arrays, writes pn, ap (9);
         K2 reads w, r, pn, ap, dinv, writes w, r, z (8) => 17
         (more traffic than xla — why it only wins while compute-bound)
+      pipelined / pipelined-pallas — bundle+stencil pass reads
+        r, u, w, s, p, dinv, a, b and writes n (9); the seven-vector
+        update pass reads n, z, s, p, u, w, r, x, dinv and writes
+        z, s, p, x, r, u, w (16); + the 4-stencil residual replacement
+        amortised over its cadence => ~25.6. Twice xla's traffic —
+        the price of halving the reductions; the engine's payoff is
+        collective latency on the mesh, not HBM economy.
       resident — HBM touched twice per *solve*, not per iteration => 0
       streamed — state is VMEM-resident; only non-resident operands
         stream (``StreamPlan.streamed_passes_per_iter``)
@@ -75,6 +82,10 @@ def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
         return 13.0
     if engine == "fused":
         return 17.0
+    if engine in ("pipelined", "pipelined-pallas"):
+        from poisson_ellipse_tpu.ops.pipelined_pcg import REPLACE_EVERY
+
+        return 25.0 + 4.0 * 5.0 / REPLACE_EVERY
     if engine == "xl":
         from poisson_ellipse_tpu.ops.xl_pcg import XLPlan
 

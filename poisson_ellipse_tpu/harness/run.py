@@ -242,12 +242,14 @@ def run_once(
             fence(args)
         shape = (1, 1)
     elif mode == "sharded":
-        if engine not in ("auto", "xla", "pallas", "fused"):
+        if engine not in ("auto", "xla", "pallas", "fused", "pipelined"):
             raise ValueError(
                 f"engine {engine!r} is single-device only; sharded mode "
                 "runs the XLA block stencil ('xla', default), the "
-                "per-shard Pallas stencil kernel ('pallas'), or the "
-                "two-kernel fused per-shard iteration ('fused', f32/bf16)"
+                "per-shard Pallas stencil kernel ('pallas'), the "
+                "two-kernel fused per-shard iteration ('fused', f32/bf16), "
+                "or the one-psum-per-iteration pipelined recurrence "
+                "('pipelined')"
             )
         engine = "xla" if engine == "auto" else engine
         with timer.phase("init"):

@@ -145,6 +145,9 @@ def load_config(root: Optional[str] = None) -> LintConfig:
             table.get("jit-factory-patterns", cfg.jit_factory_patterns)
         ),
         assumed_itemsize=table.get("assumed-itemsize", cfg.assumed_itemsize),
+        reduction_roots=tuple(
+            table.get("reduction-roots", cfg.reduction_roots)
+        ),
     )
 
 

@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU006.
+"""The tpulint rule registry: TPU001–TPU007.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -15,6 +15,8 @@ silent — a lint gate that cries wolf gets deleted from CI.
 | TPU004 | missing-donation   | jit with large-array params, no donate_argnums|
 | TPU005 | pallas-tile        | BlockSpec off the (8, 128) grid / VMEM budget |
 | TPU006 | jit-per-call       | jax.jit rebuilt per loop step / per call      |
+| TPU007 | unfused-reductions | adjacent independent global reductions in one |
+|        |                    | loop body that could share a stacked collective|
 """
 
 from __future__ import annotations
@@ -50,6 +52,11 @@ class LintConfig:
     jit_factory_patterns: tuple[str, ...] = ("build_*", "make_*")
     # TPU005: itemsize assumed for tiles whose dtype cannot be resolved.
     assumed_itemsize: int = 4
+    # TPU007: additional reduction-rooted callables (fnmatch patterns
+    # over resolved qualnames) beyond the built-in jax.lax.psum /
+    # jax.numpy.sum — a project names its own grid_dot-style wrappers
+    # here so the rule sees through them.
+    reduction_roots: tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -509,6 +516,124 @@ def check_pallas_tile(module: Module, config: LintConfig) -> Iterator[Finding]:
                     "(utils/device.py capability table) — tile smaller or "
                     "gate the kernel on `utils.device.vmem_capacity_bytes`",
                 )
+
+
+# --------------------------------------------------------------------------
+# TPU007 — adjacent un-fused global reductions in one jitted loop body
+# --------------------------------------------------------------------------
+
+# reductions every jax project has; projects add their own wrappers via
+# LintConfig.reduction_roots ([tool.tpulint] reduction-roots)
+_REDUCTION_ROOTS = ("jax.lax.psum", "jax.numpy.sum")
+
+
+def _statement_targets(stmt: ast.stmt) -> set[str]:
+    names: set[str] = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # a def whose body holds the reduction: its influence flows
+        # through the bound name (callers of the closure)
+        names.add(stmt.name)
+    else:
+        # compound statement (if/for/with/try...) holding the reduction:
+        # every name it stores is a potential carrier — over-approximate
+        # so a dependent follow-up reduction stays silent
+        names |= {
+            n.id
+            for n in ast.walk(stmt)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+        }
+    for target in targets:
+        names |= {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+    return names
+
+
+def _reads_any(module: Module, stmt: ast.stmt, names: set[str]) -> bool:
+    """Does the statement read any of ``names``? Assignments are tested
+    on their value expression; compound statements (a nested ``def``
+    whose body consumes a reduction-derived scalar, a loop, a ``with``)
+    on the whole node — over-approximating reads keeps the rule quiet
+    exactly when the dependence question gets murky."""
+    node = getattr(stmt, "value", None)
+    if node is None:
+        node = stmt
+    return module.expr_mentions(node, names)
+
+
+def _reduction_sites(module: Module, stmt: ast.stmt, roots) -> list[ast.Call]:
+    """Calls in ``stmt`` whose callee resolves to a global-reduction root.
+
+    ``jnp.sum`` with an explicit ``axis=`` is a partial reduction (stays
+    an array), not a scalar collective candidate — skipped.
+    """
+    out = []
+    for node in ast.walk(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        q = module.qualname(node.func) or ""
+        if not any(fnmatch.fnmatch(q, pat) for pat in roots):
+            continue
+        if q.rsplit(".", 1)[-1] == "sum" and (
+            len(node.args) > 1  # positional axis: jnp.sum(a, 0)
+            or any(kw.arg in ("axis", "axes") for kw in node.keywords)
+        ):
+            continue
+        out.append(node)
+    return out
+
+
+@rule(
+    "TPU007",
+    "unfused-reductions",
+    "two adjacent independent global reductions (psum / jnp.sum-rooted "
+    "dots) in one jitted loop body that could share a single stacked "
+    "collective",
+)
+def check_unfused_reductions(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """Inside a ``lax.while_loop``/``scan``/``fori_loop`` body, two
+    reduction-rooted statements with no data dependence between them
+    serialize the loop on two reduce→broadcast latencies where a single
+    stacked reduction (``jnp.stack`` of the partials → one ``psum`` /
+    one fused sum pass) would pay one. Reductions that are genuinely
+    sequential — the second reads a value derived from the first — are
+    the algorithm's critical path, not a fusion miss, and stay silent;
+    so do multiple reductions already stacked into one statement.
+    """
+    roots = _REDUCTION_ROOTS + tuple(config.reduction_roots)
+    for fn in module.traced_fns:
+        if fn.kind != "loop-body":
+            continue
+        body = fn.node.body
+        if not isinstance(body, list):
+            continue  # lambda body: a single expression, one statement
+        prev_line: Optional[int] = None
+        taint: set[str] = set()
+        for stmt in body:
+            sites = _reduction_sites(module, stmt, roots)
+            if not sites:
+                # propagate the previous reduction's influence forward
+                if prev_line is not None and _reads_any(module, stmt, taint):
+                    taint |= _statement_targets(stmt)
+                continue
+            if prev_line is not None and not _reads_any(module, stmt, taint):
+                yield _finding(
+                    module,
+                    sites[0],
+                    "TPU007",
+                    "global reduction independent of the one at line "
+                    f"{prev_line} in the same loop body: the two "
+                    "serialize on separate reduce->broadcast latencies "
+                    "(2 collectives on a mesh) — stack the partials and "
+                    "issue one fused reduction (the grid_dots / stacked-"
+                    "psum idiom), or suppress with a note when the "
+                    "ordering is load-bearing",
+                )
+            prev_line = stmt.lineno
+            taint = _statement_targets(stmt)
 
 
 # --------------------------------------------------------------------------

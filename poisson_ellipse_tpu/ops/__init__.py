@@ -17,7 +17,7 @@ from poisson_ellipse_tpu.ops.stencil import (
     diag_d_block,
     apply_dinv,
 )
-from poisson_ellipse_tpu.ops.reduction import grid_dot
+from poisson_ellipse_tpu.ops.reduction import grid_dot, grid_dots
 
 __all__ = [
     "coefficients_at",
@@ -32,4 +32,5 @@ __all__ = [
     "diag_d_block",
     "apply_dinv",
     "grid_dot",
+    "grid_dots",
 ]

@@ -42,6 +42,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from poisson_ellipse_tpu.parallel.compat import shape_dtype_struct
+
 # Rows of output computed per grid step. 128 keeps the three (TM+2)-row
 # f32 input windows + one TM-row output tile a few MB — comfortably in
 # the ~16 MB VMEM with room for Mosaic's own buffers.
@@ -171,11 +173,7 @@ def apply_a_block_pallas(w_ext, a_ext, b_ext, h1, h2, interpret=None,
         out_specs=pl.BlockSpec(
             (tm, bn), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=(
-            jax.ShapeDtypeStruct((k, bn), dtype)
-            if vma is None
-            else jax.ShapeDtypeStruct((k, bn), dtype, vma=frozenset(vma))
-        ),
+        out_shape=shape_dtype_struct((k, bn), dtype, vma=vma),
         scratch_shapes=[
             pltpu.VMEM((tm + 8, cols), dtype),
             pltpu.VMEM((tm + 8, cols), dtype),
@@ -193,6 +191,149 @@ def apply_a_pallas(w, a, b, h1, h2, interpret=None):
     return jnp.pad(
         apply_a_block_pallas(w, a, b, h1, h2, interpret=interpret), 1
     )
+
+
+def _stencil_dots_kernel(h1, h2, tm, bn, n_pairs, n_tiles, *refs):
+    """One TM-row tile of the fused stencil + dot-partials pass.
+
+    Layout of ``refs`` (the pallas_call flattens them positionally):
+      inputs   w_hbm, a_hbm, b_hbm (ANY/HBM, DMA'd in aligned windows),
+               then 2·n_pairs VMEM-blocked dot operands x₀ y₀ x₁ y₁ …
+      outputs  out_ref (the stencil tile), sums_out (SMEM, (n_pairs,))
+      scratch  w_s, a_s, b_s window buffers, DMA semaphores, SMEM acc
+    """
+    w_hbm, a_hbm, b_hbm = refs[0:3]
+    pair_refs = refs[3 : 3 + 2 * n_pairs]
+    out_ref, sums_out = refs[3 + 2 * n_pairs : 5 + 2 * n_pairs]
+    w_s, a_s, b_s, sems, acc = refs[5 + 2 * n_pairs :]
+
+    i = pl.program_id(0)
+    r0 = i * tm
+    copies = [
+        pltpu.make_async_copy(src.at[pl.ds(r0, tm + 8), :], dst, sems.at[k])
+        for k, (src, dst) in enumerate(
+            [(w_hbm, w_s), (a_hbm, a_s), (b_hbm, b_s)]
+        )
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
+
+    # expression tree mirrors ops.stencil.apply_a_block term for term
+    # (each difference divided by h before combining) — ulp-compatible
+    # with the XLA stencil, same as _stencil_kernel
+    wc = w_s[1 : tm + 1, 1 : bn + 1]
+    ax = -(
+        a_s[2 : tm + 2, 1 : bn + 1] * (w_s[2 : tm + 2, 1 : bn + 1] - wc) / h1
+        - a_s[1 : tm + 1, 1 : bn + 1] * (wc - w_s[0:tm, 1 : bn + 1]) / h1
+    ) / h1
+    ay = -(
+        b_s[1 : tm + 1, 2 : bn + 2] * (w_s[1 : tm + 1, 2 : bn + 2] - wc) / h2
+        - b_s[1 : tm + 1, 1 : bn + 1] * (wc - w_s[1 : tm + 1, 0:bn]) / h2
+    ) / h2
+    out_ref[:] = ax + ay
+
+    @pl.when(i == 0)
+    def _():
+        for j in range(n_pairs):
+            acc[j] = jnp.zeros((), wc.dtype)
+
+    for j in range(n_pairs):
+        acc[j] += jnp.sum(pair_refs[2 * j][:] * pair_refs[2 * j + 1][:])
+
+    @pl.when(i == n_tiles - 1)
+    def _():
+        for j in range(n_pairs):
+            sums_out[j] = acc[j]
+
+
+def apply_a_block_dots_pallas(w_ext, a_ext, b_ext, h1, h2, pairs,
+                              interpret=None, vma=None):
+    """A·w over a halo-extended block PLUS k dot partials, one VMEM pass.
+
+    ``pairs`` is a sequence of (x, y) arrays shaped like the (bm, bn)
+    output; returns ``(Aw_block, sums)`` with ``sums[j] = Σ xⱼ·yⱼ`` (raw,
+    unweighted — the ``ops.reduction.grid_dots`` contract). The point is
+    HBM economy for the pipelined iteration: the classical structure
+    reads each dot operand once for the stencil pass and again for the
+    reduction pass, whereas here every operand streams through VMEM
+    exactly once while the stencil tile is in flight — and on a mesh the
+    (k,) partials vector is exactly what rides the iteration's single
+    stacked ``lax.psum`` (``parallel.pipelined_sharded``).
+
+    Tiling/alignment contract is ``apply_a_block_pallas``'s: stencil
+    inputs stay in ANY/HBM and are DMA'd in aligned (TM+8)-row windows;
+    the dot operands ride ordinary double-buffered BlockSpec pipelining.
+    The TPU grid runs tiles sequentially, so SMEM accumulators finish the
+    reductions on device (``_dot_kernel``'s structure, widened to k).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    pairs = tuple(pairs)
+    n_pairs = len(pairs)
+    if n_pairs == 0:
+        raise ValueError("need at least one (x, y) dot pair")
+    bm = w_ext.shape[0] - 2
+    bn = w_ext.shape[1] - 2
+    n_tiles = -(-bm // TILE_ROWS)
+    tm = round_up(-(-bm // n_tiles), 8)
+    k = round_up(bm, tm)
+    cols = round_up(bn + 2, 128)
+    pad = ((0, k + 8 - (bm + 2)), (0, cols - (bn + 2)))
+    w_p = jnp.pad(w_ext, pad)
+    a_p = jnp.pad(a_ext, pad)
+    b_p = jnp.pad(b_ext, pad)
+    # zero row padding: contributes nothing to the dot partials
+    flat = []
+    for x, y in pairs:
+        flat += [jnp.pad(x, ((0, k - bm), (0, 0))), jnp.pad(y, ((0, k - bm), (0, 0)))]
+    dtype = w_ext.dtype
+    blk = lambda: pl.BlockSpec(
+        (tm, bn), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _stencil_dots_kernel, float(h1), float(h2), tm, bn, n_pairs,
+        k // tm,
+    )
+    out, sums = pl.pallas_call(
+        kernel,
+        grid=(k // tm,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3
+        + [blk() for _ in range(2 * n_pairs)],
+        out_specs=(
+            pl.BlockSpec((tm, bn), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            shape_dtype_struct((k, bn), dtype, vma=vma),
+            shape_dtype_struct((n_pairs,), dtype, vma=vma),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.SMEM((n_pairs,), dtype),
+        ],
+        interpret=interpret,
+    )(w_p, a_p, b_p, *flat)
+    return out[:bm], sums
+
+
+def apply_a_dots_pallas(w, a, b, h1, h2, pairs, interpret=None):
+    """Full-node-grid twin of ``apply_a_block_dots_pallas``: (M+1, N+1)
+    inputs, stencil written on the interior with a zero boundary ring,
+    dot pairs over the full node grid (iterates are zero on the ring, so
+    full-grid sums equal interior sums — the ``ops.reduction`` layout
+    invariant)."""
+    # dot operands enter the kernel cropped to the stencil's (bm, bn)
+    # interior tile shape; the ring they lose is exactly zero
+    cropped = tuple((x[1:-1, 1:-1], y[1:-1, 1:-1]) for x, y in pairs)
+    out, sums = apply_a_block_dots_pallas(
+        w, a, b, h1, h2, cropped, interpret=interpret
+    )
+    return jnp.pad(out, 1), sums
 
 
 def _dinv_kernel(r_ref, d_ref, out_ref):

@@ -1,0 +1,296 @@
+"""Pipelined PCG (Ghysels–Vanroose): one fused reduction per iteration.
+
+The classical recurrence (``solver.pcg``) serializes every iteration on
+TWO dependent global reductions — ``denom = (Ap, p)`` must finish before
+the axpy updates that feed ``zr_new``/``‖Δw‖²`` can even start, so the
+loop's critical path is stencil → reduce → update → reduce. Pipelined CG
+(Ghysels & Vanroose 2014; the α/β derivation goes back to Chronopoulos &
+Gear's s-step CG) reorders the recurrence so that **all** inner products
+of an iteration are functions of vectors already in hand at its start,
+letting them ride ONE stacked reduction — and leaving the iteration's
+stencil application with no data dependence on that reduction, so the two
+overlap. On the mesh this halves the collectives per iteration from 2
+``lax.psum`` to 1 (``parallel.pipelined_sharded``); on a single chip it
+shortens the reduce→broadcast critical path and shrinks the fusion count.
+
+Recurrence, with M = D (Jacobi) and the reference's h1·h2-weighted dots.
+Carry adds s = A·p, u = M⁻¹r, w = A·u (and the auxiliary z = A·M⁻¹s) to
+the classical (x, r, p):
+
+  [one fused dot bundle, from carried vectors only]
+    γ = (r, u)   (w,u)  (w,p)  (s,u)  (s,p)  (u,u)  (u,p)  (p,p)
+  [stencil of this iteration — independent of the bundle: overlaps it]
+    m = M⁻¹ w
+    n = A m
+  β  = γ/γ₋₁                                  (0 at the first iteration)
+  α  = γ / [(w,u) + β((w,p) + (s,u)) + β²(s,p)]
+  z⁺ = n + β z      s⁺ = w + β s      p⁺ = u + β p
+  x⁺ = x + α p⁺     r⁺ = r − α s⁺
+  u⁺ = u − α M⁻¹s⁺  w⁺ = w − α z⁺
+
+(M is diagonal, hence linear: M⁻¹s⁺ is exactly the classical q-recurrence
+q⁺ = m + β q, so q needs no carry slot.) The α-denominator expands
+(A p⁺, p⁺) = (w + βs, u + βp) directly from the bundle — the same value
+Ghysels–Vanroose's scalar recursion δ − βγ/α₋₁ propagates, but evaluated
+as inner products each iteration, which avoids that recursion's
+catastrophic cancellation near convergence (their §4.3 stability
+discussion; measured: the recursive form breaks down spuriously in f32
+on the stiff 1/ε operators, the expanded form does not). Breakdown keeps
+the reference's ``DENOM_GUARD`` semantics: that denominator under 1e-15
+discards the iteration's update and exits, exactly as
+``stage0/Withoutopenmp1.cpp:128`` returns before touching w/r. The
+convergence norm ‖Δx‖ = α‖p⁺‖ is assembled from the bundle too:
+(p⁺,p⁺) = (u,u) + 2β(u,p) + β²(p,p).
+
+Accuracy note: pipelined CG is a *reordering* of the same Krylov
+recurrence, not a bit-identical evaluation — α/β are algebraically equal
+to the classical values but computed through different FP expressions,
+and w = A·u is maintained by recurrence rather than recomputed, so
+round-off accumulates differently. On the published oracle grids the
+iteration counts land within ±2 of the ``xla`` engine and the solutions
+within fractions of a percent in L2 (asserted in
+``tests/test_pipelined.py``); bitwise oracle-count parity remains the
+classical engines' contract.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.reduction import grid_dots
+from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
+from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
+
+# Residual-replacement period (iterations). The recurrence-maintained
+# vectors (r, u, w, z, s) accumulate round-off the classical loop does
+# not have; every REPLACE_EVERY-th iteration recomputes them from x and
+# p (4 stencil applications), which bounds the drift — without it the
+# f32 path breaks down spuriously on the stiff 1/ε operators hundreds of
+# iterations in (Ghysels & Vanroose §4.3's residual replacement, on a
+# fixed cadence so chunked advances stay bit-identical to straight runs).
+# Amortised cost: 4/32 ≈ 0.13 extra stencil passes per iteration.
+REPLACE_EVERY = 32
+
+
+def init_state(problem: Problem, a, b, rhs, stencil: str = "xla",
+               interpret=None):
+    """The pipelined carry at iteration 0 (the resumable solver state).
+
+    Layout: (k, x, r, u, w, z, s, p, γ₋₁, diff, converged, breakdown).
+    One stencil application (w₀ = A u₀) happens here, outside the loop;
+    z/s/p start at zero because β = 0 on the first iteration rebuilds
+    them from (n, w, u) alone. γ₋₁ starts at 1 — it only ever divides
+    under a β that the first pass forces to 0, so the value never
+    surfaces.
+    """
+    dtype = rhs.dtype
+    d = diag_d(a, b, jnp.asarray(problem.h1, dtype), jnp.asarray(problem.h2, dtype))
+    apply_stencil = _stencil_fn(problem, a, b, d, stencil, dtype, interpret)
+    r0 = rhs
+    u0 = apply_dinv(r0, d)
+    w0 = apply_stencil(u0)
+    zeros = jnp.zeros_like(rhs)
+    one = jnp.asarray(1.0, dtype)
+    return (
+        jnp.asarray(0, jnp.int32),
+        zeros,  # x
+        r0,
+        u0,
+        w0,
+        zeros,  # z
+        zeros,  # s
+        zeros,  # p
+        one,    # γ of the previous iteration
+        jnp.asarray(jnp.inf, dtype),
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+
+
+def _stencil_fn(problem: Problem, a, b, d, stencil: str, dtype,
+                interpret=None):
+    """The A·(·) closure for one engine flavour.
+
+    "xla" leaves the stencil to XLA's fusion; "pallas" runs the fused
+    stencil+partials kernel's stencil-only path for the init application
+    (the in-loop call goes through ``apply_a_dots_pallas`` so the dot
+    operands stream from HBM once, alongside the stencil's own reads).
+    """
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    if stencil == "xla":
+        return lambda m: apply_a(m, a, b, h1, h2)
+    if stencil == "pallas":
+        from poisson_ellipse_tpu.ops.pallas_kernels import apply_a_pallas
+
+        return lambda m: apply_a_pallas(
+            m, a, b, problem.h1, problem.h2, interpret=interpret
+        )
+    raise ValueError(f"unknown stencil: {stencil!r}")
+
+
+def advance(problem: Problem, a, b, rhs, state, limit=None,
+            stencil: str = "xla", interpret=None):
+    """Advance the pipelined carry until convergence/breakdown or
+    iteration ``limit`` (defaults to max_iterations).
+
+    Chunked runs (limit=k, k+K, …) are bit-identical to one straight run
+    — chunking only moves the while_loop boundary, not the arithmetic
+    (same contract as ``solver.pcg.advance``).
+    """
+    dtype = rhs.dtype
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    hw = h1 * h2
+    delta = jnp.asarray(problem.delta, dtype)
+    weighted = problem.norm == "weighted"
+    max_iter = (
+        problem.max_iterations
+        if limit is None
+        else jnp.minimum(
+            jnp.asarray(limit, jnp.int32), problem.max_iterations
+        )
+    )
+    d = diag_d(a, b, h1, h2)
+    apply_stencil = _stencil_fn(problem, a, b, d, stencil, dtype, interpret)
+
+    if stencil == "pallas":
+        from poisson_ellipse_tpu.ops.pallas_kernels import apply_a_dots_pallas
+
+        def stencil_and_dots(m, r, u, w, s, p):
+            # one VMEM pass: n = A·m AND the eight dot partials, every
+            # operand read from HBM exactly once
+            return apply_a_dots_pallas(
+                m, a, b, problem.h1, problem.h2, _bundle(r, u, w, s, p),
+                interpret=interpret,
+            )
+
+    else:  # "xla" (anything else was rejected by _stencil_fn above)
+
+        def stencil_and_dots(m, r, u, w, s, p):
+            return apply_stencil(m), grid_dots(*_bundle(r, u, w, s, p))
+
+    def cond(state):
+        k = state[0]
+        converged, breakdown = state[10], state[11]
+        return (k < max_iter) & ~converged & ~breakdown
+
+    def replace(k, x, r, u, w, z, s, p, rhs):
+        """Residual replacement: rebuild the recurrence-maintained
+        vectors from the ground-truth x and p. Keyed purely on the
+        iteration counter, so chunking cannot move it."""
+
+        def rebuilt(_):
+            r_t = rhs - apply_stencil(x)
+            u_t = apply_dinv(r_t, d)
+            s_t = apply_stencil(p)
+            return (
+                r_t, u_t, apply_stencil(u_t),
+                apply_stencil(apply_dinv(s_t, d)), s_t,
+            )
+
+        do = (k > 0) & (k % REPLACE_EVERY == 0)
+        return lax.cond(do, rebuilt, lambda _: (r, u, w, z, s), None)
+
+    def body(state):
+        k, x, r, u, w, z, s, p, g_prev, diff_prev, _c, _bd = state
+        r, u, w, z, s = replace(k, x, r, u, w, z, s, p, rhs)
+
+        # the iteration's one fused reduction (γ and the α/norm terms)
+        # and its one stencil application — the stencil has no data
+        # dependence on the reduction, so on a mesh XLA overlaps the
+        # psum with the halo exchange + stencil
+        # (parallel.pipelined_sharded); here they share one fusion pass
+        m = apply_dinv(w, d)
+        n, sums = stencil_and_dots(m, r, u, w, s, p)
+        gamma = sums[0] * hw
+        wu, wp, su, sp = sums[1], sums[2], sums[3], sums[4]
+        uu, up, pp = sums[5], sums[6], sums[7]
+
+        first = k == 0
+        beta = jnp.where(
+            first, 0.0, gamma / jnp.where(first, 1.0, g_prev)
+        )
+        # (A p⁺, p⁺) = (w + βs, u + βp), expanded over the bundle — the
+        # reference's breakdown guard applies to it unchanged
+        # (stage0/Withoutopenmp1.cpp:128)
+        denom = (wu + beta * (wp + su) + beta * beta * sp) * hw
+        breakdown = denom < DENOM_GUARD
+        alpha = gamma / jnp.where(breakdown, 1.0, denom)
+
+        z_new = n + beta * z
+        s_new = w + beta * s
+        p_new = u + beta * p
+        x_new = x + alpha * p_new
+        r_new = r - alpha * s_new
+        u_new = u - alpha * apply_dinv(s_new, d)
+        w_new = w - alpha * z_new
+
+        # ‖Δx‖ = α‖p⁺‖ from the bundle (no extra pass over x)
+        pp_new = uu + 2.0 * beta * up + beta * beta * pp
+        dw2 = alpha * alpha * pp_new
+        diff = jnp.sqrt(dw2 * hw) if weighted else jnp.sqrt(dw2)
+        converged = ~breakdown & (diff < delta)
+        diff = jnp.where(breakdown, diff_prev, diff)
+
+        # a breakdown iteration discards its update entirely (the
+        # reference exits before touching w/r)
+        keep = lambda old, new: jnp.where(breakdown, old, new)
+        return (
+            k + 1,
+            keep(x, x_new), keep(r, r_new), keep(u, u_new), keep(w, w_new),
+            keep(z, z_new), keep(s, s_new), keep(p, p_new),
+            keep(g_prev, gamma),
+            diff, converged, breakdown,
+        )
+
+    return lax.while_loop(cond, body, state)
+
+
+def _bundle(r, u, w, s, p):
+    """The iteration's eight dot pairs, in bundle order: γ, the four
+    α-denominator terms, and the three ‖Δx‖-recurrence terms."""
+    return (
+        (r, u),
+        (w, u), (w, p), (s, u), (s, p),
+        (u, u), (u, p), (p, p),
+    )
+
+
+def result_of(state) -> PCGResult:
+    """View a pipelined carry as a PCGResult."""
+    k, x = state[0], state[1]
+    diff, converged, breakdown = state[9], state[10], state[11]
+    return PCGResult(
+        w=x, iters=k, diff=diff, converged=converged, breakdown=breakdown
+    )
+
+
+def pcg_pipelined(problem: Problem, a, b, rhs, stencil: str = "xla",
+                  interpret=None):
+    """Run pipelined PCG for pre-assembled coefficients ((M+1, N+1) grids).
+
+    Jit-safe with ``problem`` static; the while_loop carries
+    (k, x, r, u, w, z, s, p, γ, diff, converged, breakdown) entirely on
+    device. stencil "xla" (fused by XLA, any dtype) or "pallas" (the
+    fused stencil+partials kernel, f32/bf16 on hardware; ``interpret``
+    forces/suppresses the kernels' interpreter mode, default: interpret
+    off-TPU).
+    """
+    state = advance(
+        problem, a, b, rhs,
+        init_state(problem, a, b, rhs, stencil=stencil, interpret=interpret),
+        stencil=stencil, interpret=interpret,
+    )
+    return result_of(state)
+
+
+def solve(problem: Problem, dtype=jnp.float32, stencil: str = "xla",
+          interpret=None) -> PCGResult:
+    """Assemble and solve on a single chip with the pipelined recurrence."""
+    a, b, rhs = assembly.assemble(problem, dtype)
+    return pcg_pipelined(problem, a, b, rhs, stencil=stencil,
+                         interpret=interpret)

@@ -24,3 +24,18 @@ def grid_dot(u, v, h1, h2):
 def grid_sumsq(u):
     """Unweighted Σ u²  — used by the stage0 convergence-norm convention."""
     return jnp.sum(u * u)
+
+
+def grid_dots(*pairs):
+    """All Σ uᵢ·vᵢ of ``pairs`` as one stacked (k,) reduction.
+
+    The fusion idiom shared by the single-chip and sharded loops: every
+    inner product an iteration needs is emitted from ONE pass over the
+    operands (XLA fuses the k elementwise products and row reductions
+    into a single loop nest), and — decisive on the mesh — the stacked
+    result is what rides a single ``lax.psum`` instead of k collectives
+    (``parallel.pcg_sharded`` stacks by hand; this is that idiom named).
+    Sums are raw (unweighted); callers apply their h1·h2 weights to the
+    entries that want them, exactly as ``grid_dot`` would have.
+    """
+    return jnp.stack([jnp.sum(u * v) for u, v in pairs])

@@ -41,6 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.parallel.compat import tpu_compiler_params
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.ops.fused_pcg import fused_operands
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
@@ -170,6 +171,9 @@ def _mega_kernel(h1, h2, delta, weighted, max_iter,
         # oracle counts depend on the FP difference (cu:626-660)
         dw = w_new - w
         dw2 = jnp.sum(dw * dw)
+        # two VPU reductions over VMEM-resident values inside ONE Mosaic
+        # kernel: no collective and no HBM pass exists to fuse away
+        # tpulint: disable=TPU007
         zr_new = jnp.sum((r_new * dinv_v) * r_new) * h1h2
 
         ndiff = jnp.sqrt(dw2 * h1h2) if weighted else jnp.sqrt(dw2)
@@ -240,7 +244,7 @@ def build_resident_solver(problem: Problem, dtype=jnp.float32,
             pltpu.VMEM((g1p, g2p), dtype),  # r
             pltpu.VMEM((g1p, g2p), dtype),  # p
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=scaled_vmem_budget(_VMEM_LIMIT)
         ),
         interpret=interpret,

@@ -58,6 +58,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.parallel.compat import tpu_compiler_params
 from poisson_ellipse_tpu.ops.streamed_pcg import (
     _VMEM_LIMIT,
     _interpret_default,
@@ -301,6 +302,11 @@ def _mega_kernel(problem: Problem, plan: XLPlan, weighted: bool,
                 apt, pc = stencil_ring(s, aslot)
                 ap_buf[pl.ds(aslot * tm, tm), :] = apt
                 store("ap", ap_buf, ap_hbm, s, aslot).start()
+                # per-tile SMEM accumulation inside one pipelined Mosaic
+                # kernel (the dw2 cell fills in the update phase, this
+                # one a stencil-lag behind): already one kernel, no
+                # collective to stack
+                # tpulint: disable=TPU007
                 acc[1] += jnp.sum(apt * pc)
 
             return carry
@@ -362,6 +368,10 @@ def _mega_kernel(problem: Problem, plan: XLPlan, weighted: bool,
                 1.0 / jnp.where(dvt != 0.0, dvt, jnp.ones_like(dvt)),
                 jnp.zeros_like(dvt),
             )
+            # per-tile SMEM accumulation in the C sweep of the same
+            # kernel — the AB-sweep cells are sequenced by the pipeline,
+            # not by a fusable reduction pair
+            # tpulint: disable=TPU007
             acc[2] += jnp.sum((z_new * z_new) * dt)
             return carry
 
@@ -444,7 +454,7 @@ def build_xl_solver(problem: Problem, dtype=jnp.float32, interpret=None,
             pltpu.SMEM((3,), dtype),
             pltpu.SemaphoreType.DMA((_NSEMS,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=scaled_vmem_budget(_VMEM_LIMIT)
         ),
         interpret=interpret,

@@ -17,6 +17,13 @@ exchange (``:241-347``, ``poisson_mpi_cuda2.cu:331-500``) and
               collectives, vs the reference's 4 MPI_Sendrecv (with
               host-staged D2H/H2D copies) + 3 MPI_Allreduce + ≥3
               device-host partial-sum round-trips,
+- ``pipelined_sharded``: the Ghysels–Vanroose reordering of the same
+              solve — ONE stacked ``psum`` per iteration (all dot
+              partials together), overlapped by XLA with the halo
+              exchange + stencil; the collective-latency engine,
+- ``compat``: the jax-version shim every sharding call site routes
+              through (``shard_map`` location/checker kwarg, ``pcast``,
+              vma-annotated ShapeDtypeStructs, Mosaic compiler params),
 - ``multihost``: ``jax.distributed.initialize`` lifecycle (= MPI_Init/
               Finalize) and the all-hosts global mesh — the same solver
               code rides ICI within a slice and DCN across hosts.
@@ -34,13 +41,19 @@ from poisson_ellipse_tpu.parallel.pcg_sharded import (
     build_sharded_solver,
     solve_sharded,
 )
+from poisson_ellipse_tpu.parallel.pipelined_sharded import (
+    build_pipelined_sharded_solver,
+    solve_pipelined_sharded,
+)
 
 __all__ = [
     "choose_process_grid",
     "make_mesh",
     "halo_extend",
     "build_sharded_solver",
+    "build_pipelined_sharded_solver",
     "solve_sharded",
+    "solve_pipelined_sharded",
     "global_mesh",
     "initialize_multihost",
     "process_info",

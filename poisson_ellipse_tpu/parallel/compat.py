@@ -1,0 +1,108 @@
+"""JAX API compatibility layer for the sharding/Pallas surface.
+
+The framework targets the current ``jax.shard_map`` + varying-mesh-axes
+(vma) API, but must also run on older installs where ``shard_map`` still
+lives in ``jax.experimental.shard_map``, the replication checker is the
+``check_rep`` kwarg, ``lax.pcast`` does not exist, ``ShapeDtypeStruct``
+has no ``vma`` parameter and the Mosaic compiler-params dataclass is
+named ``TPUCompilerParams``. Every such call site in the package routes
+through this module, so the version probe happens exactly once, at
+import — and a future jax bump is absorbed here, not in six engines.
+
+Pre-vma jax tracks replication implicitly (``check_rep``), so the vma
+shims (``pcast_varying``, ``shape_dtype_struct``'s ``vma``) degrade to
+no-ops there: the annotations they would install are only *read* by the
+vma checker that those versions do not have.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax import lax
+
+try:  # the promoted API (jax >= 0.4.34 exposes it; older raise)
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication/vma checker kwarg was renamed check_rep -> check_vma
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+try:
+    jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    _SDS_HAS_VMA = True
+except TypeError:
+    _SDS_HAS_VMA = False
+
+_HAS_PCAST = hasattr(lax, "pcast")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the vma checker toggled portably.
+
+    On vma-era jax the flag passes straight through. The pre-vma
+    ``check_rep`` checker has no replication rule for ``lax.while_loop``
+    — the construct at the heart of every solver here — so on those
+    versions the checker is force-disabled (jax's own documented
+    workaround); the full check still runs wherever the current API is
+    installed.
+    """
+    if _CHECK_KW == "check_rep":
+        check_vma = False
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` where it exists; on older jax
+    the same fact read from the distributed client's global state."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except (ImportError, AttributeError):
+        return False
+
+
+def pcast_varying(x, axis_names):
+    """Mark a device-invariant value as varying over ``axis_names``.
+
+    ``lax.pcast(..., to="varying")`` where the vma system exists;
+    identity elsewhere (implicit-replication jax needs no annotation for
+    a while_loop carry to type-check against per-device updates).
+    """
+    if _HAS_PCAST:
+        return lax.pcast(x, axis_names, to="varying")
+    return x
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` carrying a vma annotation when both the
+    annotation and the running jax support it."""
+    if vma is not None and _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_compiler_params(**kwargs):
+    """The Mosaic compiler-params dataclass under either of its names
+    (``pltpu.CompilerParams``, formerly ``pltpu.TPUCompilerParams``)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -64,6 +64,11 @@ from poisson_ellipse_tpu.ops.fused_pcg import (
     rotated_state0,
 )
 from poisson_ellipse_tpu.ops.pallas_kernels import _row_tile, round_up
+from poisson_ellipse_tpu.parallel.compat import (
+    pcast_varying,
+    shape_dtype_struct,
+    shard_map,
+)
 from poisson_ellipse_tpu.parallel.halo import halo_extend, halo_extend_stacked
 from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
@@ -177,9 +182,9 @@ def build_shard_kernels(bm: int, bn: int, h1: float, h2: float, dtype,
         in_specs=[smem(), blk1(), any_(), any_(), any_(), any_()],
         out_specs=(blk1(), blk1(), smem()),
         out_shape=(
-            jax.ShapeDtypeStruct((bm, bn), dtype, vma=vma),
-            jax.ShapeDtypeStruct((bm, bn), dtype, vma=vma),
-            jax.ShapeDtypeStruct((1,), dtype, vma=vma),
+            shape_dtype_struct((bm, bn), dtype, vma=vma),
+            shape_dtype_struct((bm, bn), dtype, vma=vma),
+            shape_dtype_struct((1,), dtype, vma=vma),
         ),
         scratch_shapes=[
             pltpu.VMEM((tm1 + 8, cols), dtype),
@@ -205,10 +210,10 @@ def build_shard_kernels(bm: int, bn: int, h1: float, h2: float, dtype,
         in_specs=[smem(), smem(), blk2(), blk2(), blk2(), blk2(), blk2()],
         out_specs=(blk2(), blk2(), blk2(), smem()),
         out_shape=(
-            jax.ShapeDtypeStruct((bm, bn), dtype, vma=vma),
-            jax.ShapeDtypeStruct((bm, bn), dtype, vma=vma),
-            jax.ShapeDtypeStruct((bm, bn), dtype, vma=vma),
-            jax.ShapeDtypeStruct((2,), dtype, vma=vma),
+            shape_dtype_struct((bm, bn), dtype, vma=vma),
+            shape_dtype_struct((bm, bn), dtype, vma=vma),
+            shape_dtype_struct((bm, bn), dtype, vma=vma),
+            shape_dtype_struct((2,), dtype, vma=vma),
         ),
         scratch_shapes=[pltpu.SMEM((2,), dtype)],
         interpret=interpret,
@@ -235,7 +240,7 @@ def _pad_ext(x_ext, cols: int):
 def _vary(x):
     """Broadcast a replicated scalar to mesh-varying, so kernel operand
     vma sets are uniform under shard_map's checker."""
-    return lax.pcast(x, MESH_AXES, to="varying")
+    return pcast_varying(x, MESH_AXES)
 
 
 def build_fused_sharded_solver(
@@ -287,8 +292,8 @@ def build_fused_sharded_solver(
         r0 = rhs_blk
         z0 = r0 * dinv_blk  # multiply by 1/D, as K2 does every iteration
         zr0 = pdot(z0, r0)
-        varying_zeros = lambda: lax.pcast(
-            jnp.zeros((bm, bn), dtype), MESH_AXES, to="varying"
+        varying_zeros = lambda: pcast_varying(
+            jnp.zeros((bm, bn), dtype), MESH_AXES
         )
         state0 = rotated_state0(
             varying_zeros(), r0, z0, varying_zeros(), zr0, dtype
@@ -319,7 +324,7 @@ def build_fused_sharded_solver(
         return w, k, diff, converged, breakdown
 
     spec = P(AXIS_X, AXIS_Y)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec,) * 5,

@@ -21,6 +21,7 @@ from typing import Optional
 
 import jax
 
+from poisson_ellipse_tpu.parallel.compat import distributed_is_initialized
 from poisson_ellipse_tpu.parallel.mesh import make_mesh
 
 
@@ -38,7 +39,7 @@ def initialize_multihost(
     backend. Idempotence guard: a second call is a no-op rather than an
     error, matching how the reference tolerates only one MPI_Init.
     """
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -50,7 +51,7 @@ def initialize_multihost(
 
 def shutdown_multihost() -> None:
     """``MPI_Finalize`` analog."""
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         jax.distributed.shutdown()
 
 

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.ops.stencil import apply_a_block, apply_dinv, diag_d_block
+from poisson_ellipse_tpu.parallel.compat import pcast_varying, shard_map
 from poisson_ellipse_tpu.parallel.halo import halo_extend
 from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh, padded_dims
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
@@ -103,7 +104,7 @@ def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
     breakdown), with w/r/p as per-shard blocks and replicated scalars."""
     # the zeros literal is device-invariant; mark it varying over the mesh so
     # the while_loop carry type matches the (varying) per-device updates
-    w0 = lax.pcast(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y), to="varying")
+    w0 = pcast_varying(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y))
     r0 = rhs_blk
     z0 = apply_dinv(r0, d)
     p0 = z0
@@ -219,9 +220,28 @@ def build_sharded_solver(
                  stacked (z, p) halo exchange: 2 kernels + 2 psum +
                  4 ppermute per iteration (``parallel.fused_sharded``;
                  f32/bf16, host assembly only).
+      "pipelined" — the Ghysels–Vanroose recurrence with ONE stacked
+                 psum per iteration, overlapped by XLA with the halo
+                 exchange + stencil (``parallel.pipelined_sharded``;
+                 iteration counts within ±2 of "xla", host assembly
+                 only — the collective-latency engine for multi-chip/
+                 multi-host scale).
     """
     if mesh is None:
         mesh = make_mesh()
+    if stencil_impl == "pipelined":
+        # the one-collective iteration — its own recurrence and carry
+        # layout live in parallel.pipelined_sharded
+        if assembly_mode != "host":
+            raise ValueError(
+                "stencil_impl='pipelined' assembles on the host (the "
+                f"rounded-once operand set); got assembly_mode={assembly_mode!r}"
+            )
+        from poisson_ellipse_tpu.parallel.pipelined_sharded import (
+            build_pipelined_sharded_solver,
+        )
+
+        return build_pipelined_sharded_solver(problem, mesh, dtype)
     if stencil_impl == "fused":
         # the two-kernel fused iteration composed with the mesh — its own
         # carry layout (rotated loop) and tile-aligned shard padding live
@@ -262,7 +282,7 @@ def build_sharded_solver(
         # internals mix varying refs with unvarying index values, which
         # the vma checker rejects (the kernel itself is per-shard pure);
         # compiled TPU runs keep full vma checking
-        mapped = jax.shard_map(
+        mapped = shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -287,7 +307,7 @@ def build_sharded_solver(
                 stencil_impl=stencil_impl, interpret=interpret,
             )
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(),
@@ -371,14 +391,14 @@ def build_sharded_stepper(
     # the carry cannot be donated because solver.checkpoint hands it to
     # orbax's *async* save — the serializer may still be reading the old
     # buffers while the next advance runs
-    init_mapped = jax.jit(jax.shard_map(  # tpulint: disable=TPU004
+    init_mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
         init_shard,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=state_specs,
         check_vma=check_vma,
     ))
-    advance_mapped = jax.jit(jax.shard_map(  # tpulint: disable=TPU004
+    advance_mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
         advance_shard,
         mesh=mesh,
         in_specs=(spec, spec, state_specs, scalar),

@@ -1,0 +1,232 @@
+"""Pipelined sharded PCG: ONE stacked psum collective per iteration.
+
+The plain sharded loop (``parallel.pcg_sharded``) pays 2 ``lax.psum``
+latencies per iteration, and both sit on the critical path: denom must
+arrive before the axpy updates, whose results feed the second collective.
+On the north-star configuration (large grids over many chips/hosts) that
+reduce→broadcast latency IS the iteration floor — the stencil arithmetic
+is local and fast, the collectives are not.
+
+This module composes the pipelined recurrence (``ops.pipelined_pcg``)
+with the mesh: every inner product an iteration needs is computed from
+vectors already in hand, stacked into one (8,) partials vector, and
+issued as a SINGLE ``lax.psum``. Crucially the iteration's halo exchange
+(4 ``lax.ppermute``) and stencil application consume none of that psum's
+results, so XLA's scheduler overlaps the collective with the
+neighbour-exchange + stencil compute — the same collective-fusion/overlap
+shape that hides all-reduce latency in distributed training stacks.
+
+Per iteration, per shard:
+
+  1 stacked psum             all 8 dot partials, one collective
+  1 halo exchange            m = M⁻¹w in 4 ppermutes   } independent of
+  1 stencil                  n = A m                   } the psum: overlap
+  scalar tail                β, α, breakdown/convergence
+  7 fused axpy updates       z s p x r u w
+
+versus 2 psums + 1 halo exchange for the classical sharded loop — half
+the collectives, and the remaining one hidden behind compute. Residual
+replacement (``ops.pipelined_pcg.REPLACE_EVERY``) runs on the same fixed
+cadence with two stacked halo exchanges; it is outside the steady-state
+iteration and adds no collectives.
+
+Accuracy contract is the pipelined engine's (reordering, not bitwise):
+iteration counts within ±2 of the sharded ``xla`` path on the oracle
+grids, asserted in ``tests/test_pipelined.py`` — which also pins "exactly
+one psum in the loop body" structurally, from the jaxpr.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.pipelined_pcg import REPLACE_EVERY, _bundle
+from poisson_ellipse_tpu.ops.stencil import apply_a_block, apply_dinv, diag_d_block
+from poisson_ellipse_tpu.parallel.compat import pcast_varying, shard_map
+from poisson_ellipse_tpu.parallel.halo import halo_extend, halo_extend_stacked
+from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh, padded_dims
+from poisson_ellipse_tpu.parallel.pcg_sharded import _host_sharded_args
+from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
+
+MESH_AXES = (AXIS_X, AXIS_Y)
+
+
+def build_pipelined_sharded_solver(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+):
+    """(jitted solver, args) for the pipelined mesh-sharded solve.
+
+    Operands are host-assembled in f64 and rounded once (the fidelity
+    contract every engine shares); args = the three (g1p, g2p) arrays
+    laid out P('x', 'y') over the mesh, so ``solver(*args)`` slots into
+    the same harness/bench protocol as ``build_sharded_solver``.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    px = mesh.shape[AXIS_X]
+    py = mesh.shape[AXIS_Y]
+    g1p, g2p = padded_dims(problem.node_shape, mesh)
+    bm, bn = g1p // px, g2p // py
+    spec = P(AXIS_X, AXIS_Y)
+
+    h1 = jnp.asarray(problem.h1, dtype)
+    h2 = jnp.asarray(problem.h2, dtype)
+    hw = h1 * h2
+    delta_tol = jnp.asarray(problem.delta, dtype)
+    weighted = problem.norm == "weighted"
+    max_iter = problem.max_iterations
+
+    def shard_fn(a_blk, b_blk, rhs_blk):
+        ix = lax.axis_index(AXIS_X)
+        iy = lax.axis_index(AXIS_Y)
+        gi = ix * bm + jnp.arange(bm, dtype=jnp.int32)
+        gj = iy * bn + jnp.arange(bn, dtype=jnp.int32)
+        interior = assembly.interior_mask(problem, gi, gj)
+
+        # one-time coefficient halo exchange (loop invariant)
+        a_ext = halo_extend(a_blk, px, py)
+        b_ext = halo_extend(b_blk, px, py)
+        d = jnp.where(interior, diag_d_block(a_ext, b_ext, h1, h2), 0.0)
+        maskd = interior.astype(dtype)
+
+        def stencil(v_ext):
+            return apply_a_block(v_ext, a_ext, b_ext, h1, h2) * maskd
+
+        def stencil_of(v):
+            return stencil(halo_extend(v, px, py))
+
+        def replace(k, x, r, u, w, z, s, p):
+            """Residual replacement from ground-truth x and p: two
+            stacked halo exchanges + four stencils, same cadence as the
+            single-chip engine (no collectives — psum count per
+            iteration stays at one)."""
+
+            def rebuilt(_):
+                xp_ext = halo_extend_stacked(jnp.stack([x, p]), px, py)
+                r_t = rhs_blk - stencil(xp_ext[0])
+                s_t = stencil(xp_ext[1])
+                u_t = apply_dinv(r_t, d)
+                q_t = apply_dinv(s_t, d)
+                uq_ext = halo_extend_stacked(jnp.stack([u_t, q_t]), px, py)
+                return (
+                    r_t, u_t, stencil(uq_ext[0]), stencil(uq_ext[1]), s_t
+                )
+
+            do = (k > 0) & (k % REPLACE_EVERY == 0)
+            return lax.cond(do, rebuilt, lambda _: (r, u, w, z, s), None)
+
+        r0 = rhs_blk
+        u0 = apply_dinv(r0, d)
+        w0 = stencil_of(u0)
+        zeros = lambda: pcast_varying(jnp.zeros((bm, bn), dtype), MESH_AXES)
+        state0 = (
+            jnp.asarray(0, jnp.int32),
+            zeros(),  # x
+            r0, u0, w0,
+            zeros(), zeros(), zeros(),  # z, s, p
+            jnp.asarray(1.0, dtype),    # γ of the previous iteration
+            jnp.asarray(jnp.inf, dtype),
+            jnp.asarray(False),
+            jnp.asarray(False),
+        )
+
+        def cond(state):
+            k = state[0]
+            converged, breakdown = state[10], state[11]
+            return (k < max_iter) & ~converged & ~breakdown
+
+        def body(state):
+            k, x, r, u, w, z, s, p, g_prev, diff_prev, _c, _bd = state
+            r, u, w, z, s = replace(k, x, r, u, w, z, s, p)
+
+            # THE one collective of the iteration: all 8 partials in a
+            # single stacked psum …
+            partials = jnp.stack(
+                [jnp.sum(a_ * b_) for a_, b_ in _bundle(r, u, w, s, p)]
+            )
+            sums = lax.psum(partials, MESH_AXES)
+            # … which this halo exchange + stencil do NOT consume: XLA
+            # overlaps the collective with the neighbour exchange and
+            # the stencil compute
+            m = apply_dinv(w, d)
+            n = stencil_of(m)
+
+            gamma = sums[0] * hw
+            wu, wp, su, sp = sums[1], sums[2], sums[3], sums[4]
+            uu, up, pp = sums[5], sums[6], sums[7]
+            first = k == 0
+            beta = jnp.where(
+                first, 0.0, gamma / jnp.where(first, 1.0, g_prev)
+            )
+            denom = (wu + beta * (wp + su) + beta * beta * sp) * hw
+            breakdown = denom < DENOM_GUARD
+            alpha = gamma / jnp.where(breakdown, 1.0, denom)
+
+            z_new = n + beta * z
+            s_new = w + beta * s
+            p_new = u + beta * p
+            x_new = x + alpha * p_new
+            r_new = r - alpha * s_new
+            u_new = u - alpha * apply_dinv(s_new, d)
+            w_new = w - alpha * z_new
+
+            pp_new = uu + 2.0 * beta * up + beta * beta * pp
+            dw2 = alpha * alpha * pp_new
+            diff = jnp.sqrt(dw2 * hw) if weighted else jnp.sqrt(dw2)
+            converged = ~breakdown & (diff < delta_tol)
+            diff = jnp.where(breakdown, diff_prev, diff)
+
+            keep = lambda old, new: jnp.where(breakdown, old, new)
+            return (
+                k + 1,
+                keep(x, x_new), keep(r, r_new), keep(u, u_new),
+                keep(w, w_new), keep(z, z_new), keep(s, s_new),
+                keep(p, p_new), keep(g_prev, gamma),
+                diff, converged, breakdown,
+            )
+
+        out = lax.while_loop(cond, body, state0)
+        k, x = out[0], out[1]
+        diff, converged, breakdown = out[9], out[10], out[11]
+        return x, k, diff, converged, breakdown
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, P(), P(), P(), P()),
+    )
+
+    args = _host_sharded_args(problem, mesh, dtype, g1p, g2p, spec)
+
+    def solver(*arrays):
+        x_pad, k, diff, converged, breakdown = mapped(*arrays)
+        return PCGResult(
+            w=x_pad[: problem.M + 1, : problem.N + 1],
+            iters=k,
+            diff=diff,
+            converged=converged,
+            breakdown=breakdown,
+        )
+
+    # no donation: the build-once-call-many contract re-feeds these
+    # operands on every dispatch (bench --repeat, chained solves)
+    # tpulint: disable=TPU004
+    return jax.jit(solver), args
+
+
+def solve_pipelined_sharded(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+) -> PCGResult:
+    """Assemble, shard and solve with the pipelined one-psum iteration."""
+    solver, args = build_pipelined_sharded_solver(problem, mesh, dtype)
+    return solver(*args)

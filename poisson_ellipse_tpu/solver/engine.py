@@ -17,12 +17,27 @@ best path by default:
   pallas       XLA loop + per-op Pallas      ~1.0x     (comparison engine:
                stencil kernel                           stage4's kernel-per-
                                                         op structure)
+  pipelined    Ghysels-Vanroose recurrence:  ~1.0x     (any grid, any dtype;
+               ONE fused dot bundle/iter,              iters within +-2 of
+               stencil overlaps it                     xla, not bitwise)
+  pipelined-   pipelined recurrence driving  ~1.0x     (f32/bf16; the
+  pallas       the fused stencil+partials              one-VMEM-pass form
+               Pallas kernel                           of the same loop)
 
 Policy (``select_engine``): resident if the whole working set fits VMEM;
 else streamed if the state fits; else xl. f64 always takes xla — the
 Pallas engines are f32/bf16 (TPU f64 is emulated, and the XLA path is the
 only one with an f64 story). ``fused`` never wins outright on the bench
 chip so auto never picks it, but it remains selectable for comparison.
+The ``pipelined`` pair restructures the *recurrence* (one fused reduction
+per iteration instead of two serialized ones — ``ops.pipelined_pcg``);
+on one chip that trades ~2x the streamed passes for half the
+reduce→broadcast barriers, a wash at the bench grids, so auto never
+picks it either — its payoff is the sharded path, where the single
+stacked psum halves the collectives per iteration
+(``parallel.pipelined_sharded``) and it IS the mesh engine of choice at
+collective-latency-bound scale. Iteration counts land within ±2 of xla
+(a documented reordering, not bitwise — see ``ops.pipelined_pcg``).
 
 Past the streamed gate (~2400x3200 f32; e.g. the 4096² north-star grid,
 whose state alone is ~200 MB) solves are HBM-bandwidth-bound; the xl
@@ -45,7 +60,10 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 # the Pallas engine modules import solver.pcg at their top level (which
 # runs this package's __init__), so they are imported lazily here
 
-ENGINES = ("auto", "xla", "fused", "resident", "streamed", "xl", "pallas")
+ENGINES = (
+    "auto", "xla", "fused", "resident", "streamed", "xl", "pallas",
+    "pipelined", "pipelined-pallas",
+)
 
 
 def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
@@ -139,6 +157,20 @@ def build_solver(
         from poisson_ellipse_tpu.ops.xl_pcg import build_xl_solver
 
         solver, args = build_xl_solver(problem, dtype, interpret=interpret)
+    elif engine in ("pipelined", "pipelined-pallas"):
+        from poisson_ellipse_tpu.ops.pipelined_pcg import pcg_pipelined
+
+        import jax
+
+        a, b, rhs = assembly.assemble(problem, dtype)
+        stencil = "pallas" if engine == "pipelined-pallas" else "xla"
+        # no donation: same build-once-call-many contract as the xla path
+        solver = jax.jit(  # tpulint: disable=TPU004
+            lambda a, b, rhs: pcg_pipelined(
+                problem, a, b, rhs, stencil=stencil, interpret=interpret
+            )
+        )
+        args = (a, b, rhs)
     elif engine in ("xla", "pallas"):
         # "pallas" = the XLA while_loop driving the per-op Pallas stencil
         # kernel (stage4's one-kernel-per-op structure on one chip)

@@ -32,7 +32,7 @@ from jax import lax
 
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.ops import assembly
-from poisson_ellipse_tpu.ops.reduction import grid_dot, grid_sumsq
+from poisson_ellipse_tpu.ops.reduction import grid_dot, grid_dots
 from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
 
 # PCG breakdown guard on the (Ap, p) denominator (stage0/Withoutopenmp1.cpp:128).
@@ -122,13 +122,18 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
         w_new = w + alpha * p
         r_new = r - alpha * ap
         z = apply_dinv(r_new, d)
-        zr_new = grid_dot(z, r_new, h1, h2)
 
         # ‖w^{k+1} − w^k‖ computed from the realised update (w_new − w), not
         # α·p, for bitwise parity with the reference's w/w_prev difference
         # (stage0/Withoutopenmp1.cpp:149-154; stage4 update_w_r_kernel
-        # poisson_mpi_cuda2.cu:626-660).
-        dw2 = grid_sumsq(w_new - w)
+        # poisson_mpi_cuda2.cu:626-660). Both post-update sums ride one
+        # fused reduction — the same one-reduction idiom the sharded loop
+        # stacks into a single psum (values bit-identical to the separate
+        # grid_dot/grid_sumsq calls).
+        dw = w_new - w
+        sums = grid_dots((z, r_new), (dw, dw))
+        zr_new = sums[0] * h1 * h2
+        dw2 = sums[1]
         diff = jnp.sqrt(dw2 * h1 * h2) if weighted else jnp.sqrt(dw2)
         # a breakdown iteration discards its update, so it cannot also claim
         # convergence; report the diff of the state actually retained

@@ -13,6 +13,7 @@ import pytest
 from jax.sharding import Mesh
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.parallel.compat import shard_map
 from poisson_ellipse_tpu.parallel.halo import halo_extend
 from poisson_ellipse_tpu.parallel.mesh import (
     choose_process_grid,
@@ -55,7 +56,7 @@ def test_halo_extend_reconstructs_neighbors():
         return halo_extend(blk, 2, 4)
 
     ext = jax.jit(
-        jax.shard_map(
+        shard_map(
             f,
             mesh=mesh,
             in_specs=(jax.sharding.PartitionSpec("x", "y"),),
@@ -201,7 +202,7 @@ def test_halo_extend_stacked_matches_per_array():
     spec = P(AXIS_X, AXIS_Y)
 
     singles = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a, b: (halo_extend(a, px, py), halo_extend(b, px, py)),
             mesh=mesh,
             in_specs=(spec, spec),
@@ -209,7 +210,7 @@ def test_halo_extend_stacked_matches_per_array():
         )
     )(u, v)
     stacked = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a, b: halo_extend_stacked(jnp.stack([a, b]), px, py),
             mesh=mesh,
             in_specs=(spec, spec),
@@ -275,7 +276,7 @@ def test_halo_extend_wider_width():
     width = 2
     spec = P(AXIS_X, AXIS_Y)
     ext = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda u: halo_extend(u, px, py, width=width),
             mesh=mesh,
             in_specs=spec,

@@ -27,8 +27,8 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_six_rules():
-    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 7)]
+def test_registry_has_all_seven_rules():
+    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 8)]
     for code, rule in RULES.items():
         assert rule.code == code
         assert rule.name and rule.summary
@@ -342,6 +342,151 @@ def test_tpu006_negative_module_scope_and_factories():
             return jax.jit(f)
     """
     assert codes_of(src) == []
+
+
+# -- TPU007: adjacent un-fused global reductions ----------------------------
+
+
+def test_tpu007_positive_independent_psums_in_loop_body():
+    src = """
+        from jax import lax
+
+        def advance(state):
+            def body(c):
+                a, b = c
+                s1 = lax.psum(a, "x")
+                s2 = lax.psum(b, "x")
+                return (s1, s2)
+            return lax.while_loop(lambda c: True, body, state)
+    """
+    assert codes_of(src) == ["TPU007"]
+
+
+def test_tpu007_positive_independent_jnp_sums():
+    src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def advance(state):
+            def body(c):
+                a, b = c
+                zr = jnp.sum(a * a)
+                dw2 = jnp.sum(b * b)
+                return (a * zr, b * dw2)
+            return lax.while_loop(lambda c: True, body, state)
+    """
+    assert codes_of(src) == ["TPU007"]
+
+
+def test_tpu007_negative_dependent_reductions_stay_silent():
+    """denom -> alpha -> r_new -> second dot is the algorithm's critical
+    path, not a fusion miss: the sequenced pair must not fire."""
+    src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def advance(state):
+            def body(c):
+                r, p, ap = c
+                denom = jnp.sum(ap * p)
+                alpha = 1.0 / denom
+                r_new = r - alpha * ap
+                zr = jnp.sum(r_new * r_new)
+                return (r_new, p * zr, ap)
+            return lax.while_loop(lambda c: True, body, state)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu007_negative_stacked_single_statement():
+    """The cure — partials stacked into one statement / one collective —
+    must lint clean, and reductions outside loop bodies are not the
+    rule's business."""
+    src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def init(a, b):
+            zr = jnp.sum(a * a)
+            dw = jnp.sum(b * b)
+            return zr + dw
+
+        def advance(state):
+            def body(c):
+                a, b = c
+                parts = jnp.stack([jnp.sum(a * a), jnp.sum(b * b)])
+                sums = lax.psum(parts, ("x", "y"))
+                return (a * sums[0], b * sums[1])
+            return lax.while_loop(lambda c: True, body, state)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu007_negative_axis_sum_is_not_global():
+    """Partial reductions (keyword OR positional axis) stay arrays and
+    are not collective candidates."""
+    src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def advance(state):
+            def body(c):
+                a, b = c
+                rows = jnp.sum(a, axis=0)
+                cols = jnp.sum(a, 0)
+                tot = jnp.sum(b)
+                return (a + rows + cols, b * tot)
+            return lax.while_loop(lambda c: True, body, state)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu007_negative_reduction_inside_compound_statement():
+    """A reduction assigned inside a compound statement (here an
+    unrolled `for`) still taints its target: the dependent follow-up
+    reduction is sequential, not fusable."""
+    src = """
+        from jax import lax
+
+        def advance(state):
+            def body(c):
+                a, b = c
+                for _ in range(2):
+                    s1 = lax.psum(a, "x")
+                tot = lax.psum(s1 * b, "x")
+                return (a, b * tot)
+            return lax.while_loop(lambda c: True, body, state)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu007_reduction_roots_config_knob():
+    """Project-named reduction wrappers (grid_dot-style) are only seen
+    through the reduction-roots config, matching resolved qualnames."""
+    src = """
+        from jax import lax
+        from mylib.reduce import grid_dot
+
+        def advance(state):
+            def body(c):
+                a, b = c
+                d1 = grid_dot(a, a)
+                d2 = grid_dot(b, b)
+                return (a * d1, b * d2)
+            return lax.while_loop(lambda c: True, body, state)
+    """
+    assert codes_of(src) == []
+    assert codes_of(src, reduction_roots=("*.reduce.grid_dot",)) == ["TPU007"]
+
+
+def test_tpu007_pyproject_roots_loaded():
+    import os
+
+    from poisson_ellipse_tpu.lint import load_config
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = load_config(repo_root)
+    assert "*.ops.reduction.grid_dot" in config.reduction_roots
 
 
 # -- plumbing: suppression scope, CLI, report -------------------------------

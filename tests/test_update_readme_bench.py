@@ -57,6 +57,27 @@ def make_artifact(**overrides) -> dict:
     return rec
 
 
+def test_pipelined_row_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        pipelined={
+            "grid": [100, 200], "t_solver_s": 0.45, "iters": 41,
+            "converged": True, "engine": "pipelined", "l2_error": 1e-4,
+            "t_xla_s": 0.5, "vs_xla": 1.111,
+        }
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "| 100×200 | 41 | pipelined | 0.4500 s |" in text
+    assert "1 fused reduction/iter" in text
+    assert "1.111× vs xla" in text
+    # pre-pipelined artifacts still regenerate, without the row
+    artifact.write_text(json.dumps(make_artifact()))
+    urb.regenerate(str(readme), str(artifact))
+    assert "pipelined" not in readme.read_text()
+
+
 README_STUB = """# stub
 
 <!-- bench:headline -->

@@ -160,6 +160,19 @@ def table_block(rec: dict, src: str) -> str:
             f"| {M}×{N} | {row['iters']} | {row['engine']} | "
             f"{fmt_t(row['t_solver_s'])} | — ({note}) | — |"
         )
+    pipe = rec.get("pipelined")  # absent in pre-pipelined artifacts
+    if pipe is not None:
+        M, N = pipe["grid"]
+        vs = (
+            f"{pipe['vs_xla']:g}× vs xla ({fmt_t(pipe['t_xla_s'])})"
+            if pipe.get("vs_xla")
+            else "—"
+        )
+        lines.append(
+            f"| {M}×{N} | {pipe['iters']} | pipelined | "
+            f"{fmt_t(pipe['t_solver_s'])} | — (1 fused reduction/iter) | "
+            f"{vs} |"
+        )
     f64 = rec["f64"]
     eps = rec["eps_sweep"]
     eps_iters = sorted({r["iters"] for r in eps})
