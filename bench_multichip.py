@@ -21,6 +21,8 @@ import argparse
 import json
 import sys
 
+from poisson_ellipse_tpu.obs.trace import event as trace_event, note
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="bench_multichip.py")
@@ -61,17 +63,15 @@ def main(argv=None) -> int:
         if n_virtual != args.virtual_devices:
             # a pre-set XLA_FLAGS count wins (XLA parses the flags once)
             # — say so instead of claiming the requested number
-            print(
+            note(
                 f"note: XLA_FLAGS already pins "
                 f"{n_virtual} host devices; --virtual-devices "
                 f"{args.virtual_devices} ignored",
-                file=sys.stderr,
             )
-        print(
+        note(
             f"note: virtual {n_virtual}-device CPU mesh "
             "(scaled-down grids unless --grid given); pass --real on a "
             "pod slice for the BASELINE configs",
-            file=sys.stderr,
         )
         default_strong, default_weak = (40, 40), (24, 24)
         default_meshes = [(1, 1), (2, 2), (2, 4)]
@@ -111,6 +111,7 @@ def main(argv=None) -> int:
             repeat=args.repeat,
             batch=args.batch,
         )
+        trace_event("multichip_table", **table)
         print(json.dumps(table))
         iters_ok = table["iters_consistent"] is not False
         if kind == "strong" and engine == "xla":

@@ -6,6 +6,17 @@ Argv contract extends the reference executables' (``argv[1]=M argv[2]=N``,
 ``--mesh``). Multiple grids sweep like stage0/1's built-in loops
 (``stage0/Withoutopenmp1.cpp:176-196``). ``--eps-sweep`` runs the
 fictitious-domain stiffness study of BASELINE.json config 5.
+
+Two observability entries ride the same prog:
+
+- ``--trace FILE`` (or ``POISSON_TRACE=FILE`` in the environment) streams
+  the run as structured JSONL — phase spans, per-run report events,
+  counters — in the ``obs.trace`` schema.
+- ``inspect <engine>`` is a subcommand: static cost accounting for one
+  engine (psum/ppermute per iteration from the jaxpr, XLA-estimated
+  FLOPs/HBM bytes, the roofline traffic model's columns) with no solve
+  executed — ``python -m poisson_ellipse_tpu.harness inspect pipelined
+  --mode sharded --mesh 1 2``.
 """
 
 from __future__ import annotations
@@ -22,6 +33,8 @@ from poisson_ellipse_tpu.harness.run import (
     run_once,
 )
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import metrics as obs_metrics
+from poisson_ellipse_tpu.obs import trace as obs_trace
 from poisson_ellipse_tpu.runtime.native import NativeBuildError
 from poisson_ellipse_tpu.solver.engine import ENGINES
 
@@ -72,7 +85,68 @@ def _run_threads_sweep(
     return 0 if all(r.converged for r in reports) else 1
 
 
+def _run_inspect(argv: list[str]) -> int:
+    """The ``inspect`` subcommand: static cost accounting per engine."""
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness inspect",
+        description="Static cost accounting for one solver engine: "
+        "collectives per iteration read from the jaxpr, XLA-estimated "
+        "FLOPs/HBM bytes, and the roofline traffic model side by side. "
+        "No solve is executed.",
+    )
+    ap.add_argument(
+        "engine",
+        help=f"engine to inspect (single-chip: {', '.join(ENGINES[1:])}; "
+        "sharded via --mode sharded: xla, pallas, fused, pipelined)",
+    )
+    ap.add_argument(
+        "--mode", choices=("single", "sharded"), default="single",
+        help="single-device engine or the mesh-sharded composition",
+    )
+    ap.add_argument(
+        "--mesh", type=int, nargs=2, metavar=("PX", "PY"),
+        help="mesh shape for --mode sharded (default: all devices)",
+    )
+    ap.add_argument("--grid", help="MxN grid to trace at (default 40x40)")
+    ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument(
+        "--no-xla-cost", action="store_true",
+        help="skip the XLA compile + cost analysis (jaxpr counts only)",
+    )
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    from poisson_ellipse_tpu.obs import static_cost
+
+    if args.grid:
+        m, _, n = args.grid.lower().partition("x")
+        grid = (int(m), int(n or m))
+    else:
+        grid = (40, 40)
+    try:
+        report = static_cost.engine_report(
+            Problem(M=grid[0], N=grid[1]),
+            engine=args.engine,
+            dtype=resolve_dtype(args.dtype),
+            mode=args.mode,
+            mesh_shape=tuple(args.mesh) if args.mesh else None,
+            with_xla_cost=not args.no_xla_cost,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(static_cost.render_report(report))
+    obs_trace.event("inspect", **report)
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "inspect":
+        return _run_inspect(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.harness",
         description="Fictitious-domain Poisson PCG on TPU",
@@ -166,9 +240,36 @@ def main(argv=None) -> int:
         help="capture a jax.profiler trace of the solve into this directory "
         "(open with TensorBoard / xprof)",
     )
+    ap.add_argument(
+        "--trace",
+        metavar="FILE",
+        help="append a structured JSONL run trace (phase spans, run-report "
+        "events, counters; obs.trace schema) to FILE; POISSON_TRACE=FILE "
+        "in the environment does the same without the flag",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON line per run")
     args = ap.parse_args(argv)
 
+    if args.trace:
+        obs_trace.start(args.trace)
+    obs_trace.event("cli-args", argv=list(argv))
+    rc = None
+    try:
+        rc = _run_cli(args)
+        return rc
+    finally:
+        # emit/reset unconditionally (crashed runs included): per-run
+        # aggregates — a later main() in the same process must not
+        # report this run's counts as its own
+        obs_metrics.REGISTRY.emit()
+        obs_metrics.REGISTRY.reset()
+        obs_trace.event("cli-exit", rc="error" if rc is None else rc)
+        if args.trace:
+            obs_trace.stop()
+
+
+def _run_cli(args) -> int:
+    """The measured-run body of ``main`` (post-parse, post-trace-setup)."""
     eps_values = (
         [float(e) for e in args.eps_sweep.split(",")]
         if args.eps_sweep
@@ -267,6 +368,13 @@ def main(argv=None) -> int:
                 # RuntimeErrors (incl. jax XlaRuntimeError) stay loud.
                 print(f"error: {e}", file=sys.stderr)
                 return 2
+            # the structured twin of the human summary below: one event
+            # per run, same fields as --json's line
+            obs_trace.event("run_report", **report.json_dict())
+            obs_metrics.counter("runs").inc()
+            if report.converged:
+                obs_metrics.counter("runs_converged").inc()
+            obs_metrics.gauge("last_iters").set(report.iters)
             phases = None
             if args.profile and args.mode == "native":
                 print(
@@ -291,6 +399,10 @@ def main(argv=None) -> int:
                         ),
                         dtype=jdtype,
                     )
+                # the stage4 taxonomy as spans: halo/stencil/dot/... per
+                # iteration, from the segmented replay
+                for name, secs in sorted(phases.items()):
+                    obs_trace.span_event(f"profile:{name}", secs)
             if args.json:
                 # keep stdout one JSON line per run: phases ride inside it
                 record = report.json_dict()

@@ -119,6 +119,36 @@ def scaling_table(
         "iters_consistent": (
             len({r["iters"] for r in rows}) <= 1 if not weak else None
         ),
+        # static collective accounting for this engine (jaxpr-derived
+        # psum/ppermute per iteration on the table's first mesh) — the
+        # property the pipelined series exists to demonstrate, carried
+        # in the artifact instead of prose
+        "collectives_per_iter": _static_collectives(
+            base_grid, meshes[0], dtype, stencil_impl
+        ),
+    }
+
+
+def _static_collectives(base_grid, mesh_shape, dtype: str, stencil_impl: str):
+    """{psum, ppermute} per iteration from ``obs.static_cost``, or None
+    when the mesh cannot be traced (e.g. single-device CI shards)."""
+    from poisson_ellipse_tpu.harness.run import resolve_dtype
+    from poisson_ellipse_tpu.obs import static_cost
+
+    try:
+        rep = static_cost.engine_report(
+            Problem(M=base_grid[0], N=base_grid[1]),
+            engine=stencil_impl,
+            dtype=resolve_dtype(dtype),
+            mode="sharded",
+            mesh_shape=tuple(mesh_shape),
+            with_xla_cost=False,
+        )
+    except Exception:  # noqa: BLE001 — accounting must never fail a bench
+        return None
+    return {
+        "psum": rep["psum_per_iter"],
+        "ppermute": rep["ppermute_per_iter"],
     }
 
 

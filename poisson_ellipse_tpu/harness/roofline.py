@@ -99,6 +99,21 @@ def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
     raise ValueError(f"no traffic model for engine {engine!r}")
 
 
+def modeled_hbm_bytes_per_iter(problem: Problem, engine: str,
+                               dtype=jnp.float32) -> float:
+    """The traffic model's HBM bytes per iteration for one engine —
+    ``passes_per_iter`` × unpadded node-array bytes. This is the
+    "modeled" column ``obs.static_cost`` sets next to XLA's own
+    bytes-accessed estimate (the "measured" static column), so model
+    drift against the compiler's accounting is visible per engine in
+    ``harness inspect`` instead of only as a bench-day surprise."""
+    g1, g2 = problem.node_shape
+    return (
+        passes_per_iter(problem, engine, dtype)
+        * g1 * g2 * jnp.dtype(dtype).itemsize
+    )
+
+
 def roofline(
     problem: Problem,
     engine: str,

@@ -282,11 +282,13 @@ def run_once(
         for _ in range(repeat):
             t0 = time.perf_counter()
             result = solver(*args)
-            fence(result)
+            # timing-protocol fences: the sync IS the measurement — each
+            # perf_counter bracket must close on completed device work
+            fence(result)  # tpulint: disable=TPU008
             t1s.append(time.perf_counter() - t0)
             t0 = time.perf_counter()
             out = chained(*args)
-            fence(out)
+            fence(out)  # tpulint: disable=TPU008
             tbs.append(time.perf_counter() - t0)
         t1 = statistics.median(t1s)
         times = [max(tb - t1, 0.0) / (batch - 1) for tb in tbs]
@@ -296,7 +298,9 @@ def run_once(
             t0 = time.perf_counter()
             for _ in range(batch):
                 result = solver(*args)
-            fence(result)
+            # one fence per measurement (after the batch, not per
+            # dispatch): the timing protocol's justified sync
+            fence(result)  # tpulint: disable=TPU008
             times.append((time.perf_counter() - t0) / batch)
     timer.add("solver", statistics.median(times))
 

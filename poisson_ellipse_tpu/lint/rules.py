@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU007.
+"""The tpulint rule registry: TPU001–TPU008.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -17,6 +17,9 @@ silent — a lint gate that cries wolf gets deleted from CI.
 | TPU006 | jit-per-call       | jax.jit rebuilt per loop step / per call      |
 | TPU007 | unfused-reductions | adjacent independent global reductions in one |
 |        |                    | loop body that could share a stacked collective|
+| TPU008 | host-sync-in-loop  | host sync / host callback inside a traced loop|
+|        |                    | body, or a fence-wrapper sync in a per-dispatch|
+|        |                    | Python measurement loop                        |
 """
 
 from __future__ import annotations
@@ -57,6 +60,12 @@ class LintConfig:
     # jax.numpy.sum — a project names its own grid_dot-style wrappers
     # here so the rule sees through them.
     reduction_roots: tuple[str, ...] = ()
+    # TPU008: fence-style sync wrappers (resolved-qualname fnmatch
+    # patterns) — functions that block the host on device work. Calls to
+    # them inside Python for/while loops are per-iteration host syncs:
+    # justified exactly at timing-protocol fences, which carry an
+    # annotation saying so.
+    host_sync_fns: tuple[str, ...] = ("*.timing.fence", "fence")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -198,6 +207,43 @@ _HOST_SYNC_CALLS = frozenset(
 _HOST_CAST_BUILTINS = frozenset({"float", "int", "bool"})
 
 
+def _host_sync_site(module: Module, node: ast.Call, tainted: set[str]):
+    """Classify one Call as a host-sync construct, or None.
+
+    The single source of the matcher + taint semantics shared by TPU003
+    and TPU008 (two copies drifted once — the numpy taint guard — so the
+    classification lives here exactly once). Returns (kind, label):
+    kind "method" (``x.item()``-style), "call" (``jax.device_get`` /
+    host-numpy materialisation of a traced value), or "cast"
+    (``float(x)`` on a traced value).
+    """
+    q = module.qualname(node.func) or ""
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in _HOST_SYNC_METHODS
+        and q not in _HOST_SYNC_CALLS
+    ):
+        return "method", node.func.attr
+    if q in _HOST_SYNC_CALLS:
+        # numpy.asarray/array only sync when fed a traced value; on host
+        # constants they are trace-time constant folding, not a sync
+        needs_taint = q.startswith("numpy.")
+        if not needs_taint or (
+            node.args and module.expr_mentions(node.args[0], tainted)
+        ):
+            return "call", q
+        return None
+    if (
+        isinstance(node.func, ast.Name)
+        and node.func.id in _HOST_CAST_BUILTINS
+        and q == node.func.id  # not shadowed by an import
+        and node.args
+        and module.expr_mentions(node.args[0], tainted)
+    ):
+        return "cast", node.func.id
+    return None
+
+
 def _host_sync_findings(
     module: Module,
     fn_node: ast.AST,
@@ -213,51 +259,33 @@ def _host_sync_findings(
     for node in ast.walk(fn_node):
         if not isinstance(node, ast.Call):
             continue
-        # method-style syncs: x.block_until_ready(), x.item(), x.tolist()
-        if (
-            isinstance(node.func, ast.Attribute)
-            and node.func.attr in _HOST_SYNC_METHODS
-            and module.qualname(node.func) not in _HOST_SYNC_CALLS
-        ):
-            yield _finding(
-                module,
-                node,
-                "TPU003",
-                f"`.{node.func.attr}()` is a host sync reachable from "
-                f"{origin}: the loop stalls on a device round-trip every "
-                "dispatch — hoist it out of the hot path",
-            )
+        site = _host_sync_site(module, node, tainted)
+        if site is not None:
+            kind, label = site
+            message = {
+                "method": (
+                    f"`.{label}()` is a host sync reachable from "
+                    f"{origin}: the loop stalls on a device round-trip "
+                    "every dispatch — hoist it out of the hot path"
+                ),
+                "call": (
+                    f"`{label}` forces a device→host transfer reachable "
+                    f"from {origin} — keep the hot loop device-resident"
+                ),
+                "cast": (
+                    f"`{label}()` on a traced value reachable from "
+                    f"{origin}: blocks on the device to produce a Python "
+                    "scalar — keep the value on device or move the cast "
+                    "out of the traced path"
+                ),
+            }[kind]
+            yield _finding(module, node, "TPU003", message)
             continue
-        q = module.qualname(node.func)
-        if q in _HOST_SYNC_CALLS:
-            needs_taint = q.startswith("numpy.")
-            if not needs_taint or (
-                node.args and module.expr_mentions(node.args[0], tainted)
-            ):
-                yield _finding(
-                    module,
-                    node,
-                    "TPU003",
-                    f"`{q}` forces a device→host transfer reachable from "
-                    f"{origin} — keep the hot loop device-resident",
-                )
-            continue
-        if (
-            isinstance(node.func, ast.Name)
-            and node.func.id in _HOST_CAST_BUILTINS
-            and q == node.func.id  # not shadowed by an import
-            and node.args
-            and module.expr_mentions(node.args[0], tainted)
-        ):
-            yield _finding(
-                module,
-                node,
-                "TPU003",
-                f"`{node.func.id}()` on a traced value reachable from "
-                f"{origin}: blocks on the device to produce a Python "
-                "scalar — keep the value on device or move the cast out "
-                "of the traced path",
-            )
+        if isinstance(node.func, ast.Attribute) or (
+            module.qualname(node.func) or ""
+        ) in _HOST_SYNC_CALLS:
+            # a classified-negative sync-shaped call (e.g. untainted
+            # numpy.asarray): don't descend into it as a local callee
             continue
         # shallow same-module reachability: follow calls to local defs,
         # mapping argument taint onto their parameters
@@ -282,14 +310,27 @@ def _host_sync_findings(
     "reachable from a jitted hot loop",
 )
 def check_host_sync(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """Division of labour with TPU008: syncs lexically inside a
+    ``while_loop``/``scan``/``fori_loop`` body are that rule's territory
+    (one defect, one code, one suppression) — this rule covers the
+    jit-def/jit-call surface and its same-module reachability."""
     seen: set[tuple[int, frozenset[str]]] = set()
     emitted: set[tuple[int, int]] = set()
+    loop_spans = [
+        (fn.node.lineno, getattr(fn.node, "end_lineno", fn.node.lineno))
+        for fn in module.traced_fns
+        if fn.kind == "loop-body"
+    ]
     for fn in module.traced_fns:
+        if fn.kind == "loop-body":
+            continue  # TPU008 reports these, with the loop-specific fix
         name = getattr(fn.node, "name", "<lambda>")
         origin = f"{fn.kind} `{name}`"
         for f in _host_sync_findings(
             module, fn.node, module.tainted_names(fn), origin, seen
         ):
+            if any(a <= f.line <= b for a, b in loop_spans):
+                continue  # lexically inside a loop body nested in a jit fn
             if (f.line, f.col) not in emitted:
                 emitted.add((f.line, f.col))
                 yield f
@@ -699,3 +740,140 @@ def check_jit_per_call(module: Module, config: LintConfig) -> Iterator[Finding]:
             "suppress with a note when single-shot construction is the "
             "point",
         )
+
+
+# --------------------------------------------------------------------------
+# TPU008 — host syncs / host callbacks inside loop bodies
+# --------------------------------------------------------------------------
+
+# per-iteration host callback registrars: each invocation inside a loop
+# body is a device->host round-trip every iteration (jax.debug.print is
+# asynchronous and deliberately not listed)
+_CALLBACK_REGISTRARS = frozenset(
+    {
+        "jax.debug.callback",
+        "jax.pure_callback",
+        "jax.experimental.io_callback",
+    }
+)
+
+
+def _is_fence_wrapper(q: str, config: LintConfig) -> bool:
+    return bool(q) and any(
+        fnmatch.fnmatch(q, pat) for pat in config.host_sync_fns
+    )
+
+
+@rule(
+    "TPU008",
+    "host-sync-in-loop",
+    "host sync or per-iteration host callback inside a traced loop body, "
+    "or a fence-wrapper sync inside a per-dispatch Python loop",
+)
+def check_host_sync_in_loop(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """The stage4 anti-pattern, fenced off structurally: the reference
+    synchronises host and device every PCG iteration (3 device→host
+    round-trips + 6 syncs, ``poisson_mpi_cuda2.cu:846-939``), and the
+    single design inversion this framework is built on is that nothing
+    inside the iteration ever touches the host. Two prongs:
+
+    - *traced loop bodies* (``lax.while_loop``/``scan``/``fori_loop``
+      bodies): any host-sync construct (``.item()``, ``.tolist()``,
+      ``.block_until_ready()``, ``jax.device_get``, ``float()``/``int()``/
+      ``bool()`` on a traced value, a configured fence wrapper) or any
+      host-callback registration (``jax.pure_callback``,
+      ``jax.experimental.io_callback``, ``jax.debug.callback``) — the
+      convergence-telemetry layer exists precisely so nobody needs these
+      (``obs.convergence``: on-device ring buffers instead of per-
+      iteration callbacks).
+    - *host measurement loops*: a call to a fence-style wrapper
+      (``host-sync-fns`` config; ``utils.timing.fence`` by default)
+      inside a Python ``for``/``while`` loop blocks the host once per
+      pass. At a timing-protocol fence that IS the measurement —
+      annotate the site; anywhere else it is a dispatch-pipeline stall.
+    """
+    emitted: set[tuple[int, int]] = set()
+
+    def once(finding):
+        key = (finding.line, finding.col)
+        if key not in emitted:
+            emitted.add(key)
+            yield finding
+
+    # prong 1: traced loop bodies (nested defs included — a helper defined
+    # in the body runs under the same trace)
+    for fn in module.traced_fns:
+        if fn.kind != "loop-body":
+            continue
+        tainted = module.tainted_names(fn)
+        name = getattr(fn.node, "name", "<lambda>")
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            q = module.qualname(node.func) or ""
+            site = _host_sync_site(module, node, tainted)
+            if site is not None:
+                kind, label = site
+                message = {
+                    "method": (
+                        f"`.{label}()` inside loop body `{name}`: a host "
+                        "sync EVERY iteration — the stage4 anti-pattern; "
+                        "record per-iteration scalars on device instead "
+                        "(obs.convergence ring buffers)"
+                    ),
+                    "call": (
+                        f"`{label}` inside loop body `{name}`: a "
+                        "device→host round-trip every iteration — keep "
+                        "the loop device-resident (obs.convergence "
+                        "captures per-iteration series without leaving "
+                        "the chip)"
+                    ),
+                    "cast": (
+                        f"`{label}()` on a traced value inside loop body "
+                        f"`{name}`: blocks for a Python scalar every "
+                        "iteration — keep the value on device"
+                    ),
+                }[kind]
+                yield from once(_finding(module, node, "TPU008", message))
+            elif _is_fence_wrapper(q, config):
+                yield from once(_finding(
+                    module, node, "TPU008",
+                    f"`{q}` inside loop body `{name}`: a device→host "
+                    "round-trip every iteration — keep the loop device-"
+                    "resident (obs.convergence captures per-iteration "
+                    "series without leaving the chip)",
+                ))
+            elif q in _CALLBACK_REGISTRARS:
+                yield from once(_finding(
+                    module, node, "TPU008",
+                    f"`{q}` inside loop body `{name}`: registers a host "
+                    "callback that fires every iteration — per-iteration "
+                    "telemetry belongs in on-device buffers "
+                    "(obs.convergence), not callbacks",
+                ))
+
+    # prong 2: fence wrappers inside host-level Python loops
+    loop_body_fns = {
+        id(fn.node) for fn in module.traced_fns if fn.kind == "loop-body"
+    }
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = module.qualname(node.func) or ""
+        if not _is_fence_wrapper(q, config):
+            continue
+        in_host_loop = False
+        for anc in module.ancestors(node):
+            if id(anc) in loop_body_fns:
+                in_host_loop = False  # prong 1 territory
+                break
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                in_host_loop = True
+        if in_host_loop:
+            yield from once(_finding(
+                module, node, "TPU008",
+                f"`{q}` inside a Python loop: one host↔device sync per "
+                "pass. A timing-protocol fence is the one justified case "
+                "— annotate it with a note; otherwise hoist the sync out "
+                "and let dispatches pipeline",
+            ))

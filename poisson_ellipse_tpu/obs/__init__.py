@@ -1,0 +1,53 @@
+"""obs — the observability layer over the engine zoo.
+
+Three legs, mirroring what a production solver service has to expose:
+
+- :mod:`.convergence` — on-device per-iteration history (zr / diff /
+  α / β) carried through the fused ``lax.while_loop`` as preallocated
+  ring buffers: convergence curves with zero host syncs, surfaced as
+  ``solve(..., history=True)`` on the classical, fused, pipelined and
+  sharded engines.
+- :mod:`.trace` + :mod:`.metrics` — dependency-free structured JSONL
+  run tracing (run ids, monotonic phase spans, counters/gauges) behind
+  ``--trace FILE`` / ``POISSON_TRACE=``; ``utils.timing.PhaseTimer`` is
+  a thin shim over it.
+- :mod:`.static_cost` — compile-time accounting from the jaxpr and
+  XLA's cost analysis: psum/ppermute per iteration, estimated FLOPs and
+  HBM bytes, measured-vs-modeled roofline columns — the layer that
+  turns the pipelined engine's "1 collective/iter vs classical 2" claim
+  into a regression-checked metric (``harness inspect``, BENCH
+  artifacts).
+
+:mod:`.static_cost` imports the solver engines, so it is intentionally
+NOT imported here — ``from poisson_ellipse_tpu.obs import static_cost``
+at use sites keeps this package importable from inside the solver
+modules it instruments.
+"""
+
+from poisson_ellipse_tpu.obs.convergence import (
+    HISTORY_FIELDS,
+    ConvergenceTrace,
+    history_init,
+    history_record,
+    trace_of,
+)
+from poisson_ellipse_tpu.obs.metrics import REGISTRY, MetricsRegistry, counter, gauge
+from poisson_ellipse_tpu.obs.trace import Tracer, event, note, span, start, stop
+
+__all__ = [
+    "HISTORY_FIELDS",
+    "ConvergenceTrace",
+    "MetricsRegistry",
+    "REGISTRY",
+    "Tracer",
+    "counter",
+    "event",
+    "gauge",
+    "history_init",
+    "history_record",
+    "note",
+    "span",
+    "start",
+    "stop",
+    "trace_of",
+]

@@ -1,0 +1,98 @@
+"""On-device convergence telemetry: per-iteration history with zero syncs.
+
+The reference can print per-iteration residuals because its scalar
+recurrence lives on the host; this framework's loops are single fused
+``lax.while_loop``s, so a convergence stall or an f32 breakdown is
+invisible — only the final ``PCGResult`` scalars come back. The fix is
+NOT a host callback per iteration (the stage4 anti-pattern, now linted
+as tpulint TPU008): it is a preallocated on-device ring of scalar
+buffers carried through the loop, scattered into by
+``lax.dynamic_update_slice`` at index ``k`` inside the body. The whole
+history rides the one device→host transfer the result already pays.
+
+Four series are recorded per iteration, one (cap,) buffer each, in
+:data:`HISTORY_FIELDS` order:
+
+  zr     the iteration's preconditioned-residual inner product — the
+         classical loop's ``zr_new = (z, r)``; the pipelined loop's γ
+         (the same quantity, one recurrence step earlier by that
+         engine's documented reordering); always the raw computed value,
+         before any breakdown/convergence hold.
+  diff   the step norm ‖Δw‖ as stored into the carry (on a breakdown
+         iteration this is the held previous value, exactly what the
+         solver itself reports).
+  alpha  the step length the iteration applied — exactly 0 on a
+         breakdown iteration (its update is discarded), identically in
+         every engine's trace.
+  beta   the raw direction update coefficient the iteration computed.
+
+Contract, pinned by ``tests/test_obs.py``: recording never changes the
+iterate trajectory (the history ops are pure additions — bit-identical
+results with history on/off), and with history *disabled* the emitted
+jaxpr is exactly today's (no ``dynamic_update_slice``, the original
+carry arity — the feature costs zero when off).
+
+Buffers are sized by the solve's iteration cap
+(``Problem.max_iterations``, the reference's (M-1)(N-1)); four f32
+buffers at the 800×1200 headline grid are ~15 MB total on a 16 GB part.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+HISTORY_FIELDS = ("zr", "diff", "alpha", "beta")
+
+
+class ConvergenceTrace(NamedTuple):
+    """Per-iteration solver history; entries ``[:iters]`` are valid.
+
+    The buffers stay full-length (``cap``) and zero-filled past
+    ``iters`` — trimming is a host-side choice (:meth:`valid`), never a
+    device-side reshape.
+    """
+
+    iters: jax.Array
+    zr: jax.Array
+    diff: jax.Array
+    alpha: jax.Array
+    beta: jax.Array
+
+    def valid(self) -> dict:
+        """Host-side view: {field: np.ndarray of the iters valid entries}."""
+        import numpy as np
+
+        n = int(self.iters)
+        return {
+            name: np.asarray(getattr(self, name))[:n]
+            for name in HISTORY_FIELDS
+        }
+
+
+def history_init(cap: int, dtype) -> tuple:
+    """The zeroed history carry: one (cap,) buffer per field."""
+    return tuple(jnp.zeros((int(cap),), dtype) for _ in HISTORY_FIELDS)
+
+
+def history_record(hist: tuple, k, zr, diff, alpha, beta) -> tuple:
+    """Scatter one iteration's scalars into the buffers at index ``k``.
+
+    Pure on-device arithmetic (``dynamic_update_slice`` of a length-1
+    slice) — no callback, no transfer, nothing the loop must wait on.
+    """
+    return tuple(
+        lax.dynamic_update_slice(
+            buf, jnp.reshape(val, (1,)).astype(buf.dtype), (k,)
+        )
+        for buf, val in zip(hist, (zr, diff, alpha, beta))
+    )
+
+
+def trace_of(hist: tuple, iters) -> ConvergenceTrace:
+    """View a history carry as a ConvergenceTrace."""
+    zr, diff, alpha, beta = hist
+    return ConvergenceTrace(iters=iters, zr=zr, diff=diff, alpha=alpha, beta=beta)

@@ -1,0 +1,114 @@
+"""Counters and gauges: the trace stream's aggregate half.
+
+The reference's five stage4 accumulators (``T_gpu/T_copy/T_mpi/T_prec/
+T_dot``, ``poisson_mpi_cuda2.cu:696-700``) are exactly this shape — named
+scalars incremented around work and printed once at the end. Here the
+registry is generic (any subsystem can mint a counter or gauge), and
+:meth:`MetricsRegistry.emit` publishes the whole registry into the
+ambient JSONL trace as ``counter``/``gauge`` records, so the aggregates
+land in the same machine-readable stream as the spans they summarise.
+
+Counters and gauges are *host-side* state: incrementing one from inside
+a traced loop body would be a host sync per iteration (tpulint TPU008's
+anti-pattern). On-device per-iteration series belong to
+:mod:`.convergence`; this module is for per-run aggregates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from poisson_ellipse_tpu.obs import trace as _trace
+
+
+@dataclasses.dataclass
+class Counter:
+    """A monotonically increasing named value."""
+
+    name: str
+    value: float = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease (inc({n}))")
+        self.value += n
+
+
+@dataclasses.dataclass
+class Gauge:
+    """A named value that holds its most recent observation."""
+
+    name: str
+    value: float | None = None
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class MetricsRegistry:
+    """Create-or-get registry of counters and gauges.
+
+    A name is permanently one kind: asking for ``counter("x")`` after
+    ``gauge("x")`` is a programming error and raises, instead of silently
+    shadowing one metric with another.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name in self._gauges:
+                raise ValueError(f"{name!r} is already a gauge")
+            return self._counters.setdefault(name, Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name in self._counters:
+                raise ValueError(f"{name!r} is already a counter")
+            return self._gauges.setdefault(name, Gauge(name))
+
+    def snapshot(self) -> dict:
+        """{"counters": {name: value}, "gauges": {name: value}} — set
+        gauges only (an unobserved gauge has nothing to report)."""
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {
+                    n: g.value
+                    for n, g in self._gauges.items()
+                    if g.value is not None
+                },
+            }
+
+    def emit(self, tracer=None) -> None:
+        """Publish every metric into the JSONL trace (ambient tracer by
+        default; silently nothing when tracing is inactive)."""
+        tracer = tracer or _trace.active()
+        if tracer is None:
+            return
+        snap = self.snapshot()
+        for name, value in sorted(snap["counters"].items()):
+            tracer.emit("counter", name, value=value)
+        for name, value in sorted(snap["gauges"].items()):
+            tracer.emit("gauge", name, value=value)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+# the process-default registry (the harness/bench drivers use this one)
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)

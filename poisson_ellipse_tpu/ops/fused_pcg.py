@@ -57,6 +57,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs.convergence import (
+    history_init,
+    history_record,
+    trace_of,
+)
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.ops.stencil import diag_d
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
@@ -405,8 +410,15 @@ def rotated_next_state(s, pn, w_new, r_new, z_new, zr_new, dw2,
 
 
 def _run_fused(problem: Problem, kern: _FusedKernels, coeffs, r0,
-               g1: int, g2: int) -> PCGResult:
-    """The rotated while_loop given prebuilt kernels + operand set."""
+               g1: int, g2: int, history: bool = False):
+    """The rotated while_loop given prebuilt kernels + operand set.
+
+    ``history=True`` appends the four ``obs.convergence`` buffers to the
+    rotated carry and records each iteration's (zr, diff, α, β) at the
+    XLA level, outside the Pallas kernels — α re-derives K2's in-kernel
+    value from the same (zr, denom) scalars and expression, so the trace
+    matches what the kernel applied; returns (PCGResult, trace).
+    """
     dtype = r0.dtype
     g1p, g2p = kern.g1p, kern.g2p
     an, as_, bw, be, d_p, dinv_p = coeffs
@@ -422,30 +434,44 @@ def _run_fused(problem: Problem, kern: _FusedKernels, coeffs, r0,
         jnp.zeros((g1p, g2p), dtype), r0, z0,
         jnp.zeros((g1p, g2p), dtype), zr0, dtype,
     )
+    if history:
+        state0 = state0 + history_init(problem.max_iterations, dtype)
 
     def body(s):
-        _k, w, r, z, p, zr, beta, _diff, _c, _bd = s
+        k, w, r, z, p, zr, beta, _diff, _c, _bd = s[:10]
         pn, ap, denom_raw = kern.k1(beta, z, p, an, as_, bw, be, d_p)
         denom = denom_raw[0] * h1 * h2
         breakdown = denom < DENOM_GUARD
         w_new, r_new, z_new, sums = kern.k2(zr, denom, w, r, pn, ap, dinv_p)
-        return rotated_next_state(
-            s, pn, w_new, r_new, z_new, sums[0] * h1 * h2, sums[1],
+        zr_new = sums[0] * h1 * h2
+        out = rotated_next_state(
+            s[:10], pn, w_new, r_new, z_new, zr_new, sums[1],
             breakdown, h1, h2, delta, weighted,
         )
+        if history:
+            # K2's guarded α, re-derived from the same scalars it read
+            alpha = zr / jnp.where(breakdown, jnp.ones_like(denom), denom)
+            alpha = jnp.where(breakdown, jnp.zeros_like(alpha), alpha)
+            beta_new = zr_new / jnp.where(breakdown, jnp.ones_like(zr), zr)
+            out = out + history_record(s[10:], k, zr_new, out[7], alpha, beta_new)
+        return out
 
     out = lax.while_loop(
         rotated_cond(problem.max_iterations), body, state0
     )
     k, w = out[0], out[1]
     diff, converged, breakdown = out[7], out[8], out[9]
-    return PCGResult(
+    result = PCGResult(
         w=w[:g1, :g2], iters=k, diff=diff,
         converged=converged, breakdown=breakdown,
     )
+    if history:
+        return result, trace_of(out[10:], k)
+    return result
 
 
-def pcg_fused(problem: Problem, a, b, rhs, interpret=None) -> PCGResult:
+def pcg_fused(problem: Problem, a, b, rhs, interpret=None,
+              history: bool = False):
     """PCG with the fused two-kernel iteration. Same value *sequence* as
     ``solver.pcg.pcg`` (reference order, rotated) up to the documented
     normalised-stencil rewrite. Jit-safe with traced a/b/rhs; the
@@ -462,10 +488,11 @@ def pcg_fused(problem: Problem, a, b, rhs, interpret=None) -> PCGResult:
     kern = build_kernels(problem, g1, g2, dtype, interpret=interpret)
     coeffs = normalized_coefficients(problem, a, b, kern.g1p, kern.g2p)
     r0 = _pad(rhs, kern.g1p, kern.g2p)
-    return _run_fused(problem, kern, coeffs, r0, g1, g2)
+    return _run_fused(problem, kern, coeffs, r0, g1, g2, history=history)
 
 
-def build_fused_solver(problem: Problem, dtype=jnp.float32, interpret=None):
+def build_fused_solver(problem: Problem, dtype=jnp.float32, interpret=None,
+                       history: bool = False):
     """(jitted solver, args) with the f64-rounded operand set.
 
     The operands (normalised coefficients + RHS) are assembled on the
@@ -492,7 +519,8 @@ def build_fused_solver(problem: Problem, dtype=jnp.float32, interpret=None):
 
     def solver(an, as_, bw, be, d_p, dinv_p, r0):
         return _run_fused(
-            problem, kern, (an, as_, bw, be, d_p, dinv_p), r0, g1, g2
+            problem, kern, (an, as_, bw, be, d_p, dinv_p), r0, g1, g2,
+            history=history,
         )
 
     # no donation: build-once-call-many — callers re-feed these operands

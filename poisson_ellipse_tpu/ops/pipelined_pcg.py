@@ -59,6 +59,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs.convergence import (
+    history_init,
+    history_record,
+    trace_of,
+)
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.ops.reduction import grid_dots
 from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
@@ -76,7 +81,7 @@ REPLACE_EVERY = 32
 
 
 def init_state(problem: Problem, a, b, rhs, stencil: str = "xla",
-               interpret=None):
+               interpret=None, history: bool = False):
     """The pipelined carry at iteration 0 (the resumable solver state).
 
     Layout: (k, x, r, u, w, z, s, p, γ₋₁, diff, converged, breakdown).
@@ -84,7 +89,8 @@ def init_state(problem: Problem, a, b, rhs, stencil: str = "xla",
     z/s/p start at zero because β = 0 on the first iteration rebuilds
     them from (n, w, u) alone. γ₋₁ starts at 1 — it only ever divides
     under a β that the first pass forces to 0, so the value never
-    surfaces.
+    surfaces. ``history=True`` appends the four ``obs.convergence``
+    buffers; the core layout is untouched.
     """
     dtype = rhs.dtype
     d = diag_d(a, b, jnp.asarray(problem.h1, dtype), jnp.asarray(problem.h2, dtype))
@@ -94,7 +100,7 @@ def init_state(problem: Problem, a, b, rhs, stencil: str = "xla",
     w0 = apply_stencil(u0)
     zeros = jnp.zeros_like(rhs)
     one = jnp.asarray(1.0, dtype)
-    return (
+    state = (
         jnp.asarray(0, jnp.int32),
         zeros,  # x
         r0,
@@ -108,6 +114,9 @@ def init_state(problem: Problem, a, b, rhs, stencil: str = "xla",
         jnp.asarray(False),
         jnp.asarray(False),
     )
+    if history:
+        state = state + history_init(problem.max_iterations, dtype)
+    return state
 
 
 def _stencil_fn(problem: Problem, a, b, d, stencil: str, dtype,
@@ -133,13 +142,16 @@ def _stencil_fn(problem: Problem, a, b, d, stencil: str, dtype,
 
 
 def advance(problem: Problem, a, b, rhs, state, limit=None,
-            stencil: str = "xla", interpret=None):
+            stencil: str = "xla", interpret=None, history: bool = False):
     """Advance the pipelined carry until convergence/breakdown or
     iteration ``limit`` (defaults to max_iterations).
 
     Chunked runs (limit=k, k+K, …) are bit-identical to one straight run
     — chunking only moves the while_loop boundary, not the arithmetic
-    (same contract as ``solver.pcg.advance``).
+    (same contract as ``solver.pcg.advance``). ``history=True``
+    expects/returns the extended carry and records (γ, diff, α, β) per
+    iteration — γ is this recurrence's zr-series (``obs.convergence``);
+    pure extra stores, iterates bit-identical either way.
     """
     dtype = rhs.dtype
     h1 = jnp.asarray(problem.h1, dtype)
@@ -196,7 +208,7 @@ def advance(problem: Problem, a, b, rhs, state, limit=None,
         return lax.cond(do, rebuilt, lambda _: (r, u, w, z, s), None)
 
     def body(state):
-        k, x, r, u, w, z, s, p, g_prev, diff_prev, _c, _bd = state
+        k, x, r, u, w, z, s, p, g_prev, diff_prev, _c, _bd = state[:12]
         r, u, w, z, s = replace(k, x, r, u, w, z, s, p, rhs)
 
         # the iteration's one fused reduction (γ and the α/norm terms)
@@ -239,13 +251,21 @@ def advance(problem: Problem, a, b, rhs, state, limit=None,
         # a breakdown iteration discards its update entirely (the
         # reference exits before touching w/r)
         keep = lambda old, new: jnp.where(breakdown, old, new)
-        return (
+        out = (
             k + 1,
             keep(x, x_new), keep(r, r_new), keep(u, u_new), keep(w, w_new),
             keep(z, z_new), keep(s, s_new), keep(p, p_new),
             keep(g_prev, gamma),
             diff, converged, breakdown,
         )
+        if history:
+            # applied α is 0 on a breakdown iteration (update discarded)
+            # — the same recording every engine's trace uses
+            out = out + history_record(
+                state[12:], k, gamma, diff,
+                jnp.where(breakdown, 0.0, alpha), beta,
+            )
+        return out
 
     return lax.while_loop(cond, body, state)
 
@@ -270,7 +290,7 @@ def result_of(state) -> PCGResult:
 
 
 def pcg_pipelined(problem: Problem, a, b, rhs, stencil: str = "xla",
-                  interpret=None):
+                  interpret=None, history: bool = False):
     """Run pipelined PCG for pre-assembled coefficients ((M+1, N+1) grids).
 
     Jit-safe with ``problem`` static; the while_loop carries
@@ -278,19 +298,24 @@ def pcg_pipelined(problem: Problem, a, b, rhs, stencil: str = "xla",
     device. stencil "xla" (fused by XLA, any dtype) or "pallas" (the
     fused stencil+partials kernel, f32/bf16 on hardware; ``interpret``
     forces/suppresses the kernels' interpreter mode, default: interpret
-    off-TPU).
+    off-TPU). history=True additionally returns the per-iteration
+    ``obs.ConvergenceTrace`` (γ/diff/α/β), captured on device.
     """
     state = advance(
         problem, a, b, rhs,
-        init_state(problem, a, b, rhs, stencil=stencil, interpret=interpret),
-        stencil=stencil, interpret=interpret,
+        init_state(problem, a, b, rhs, stencil=stencil, interpret=interpret,
+                   history=history),
+        stencil=stencil, interpret=interpret, history=history,
     )
-    return result_of(state)
+    result = result_of(state)
+    if history:
+        return result, trace_of(state[12:], result.iters)
+    return result
 
 
 def solve(problem: Problem, dtype=jnp.float32, stencil: str = "xla",
-          interpret=None) -> PCGResult:
+          interpret=None, history: bool = False):
     """Assemble and solve on a single chip with the pipelined recurrence."""
     a, b, rhs = assembly.assemble(problem, dtype)
     return pcg_pipelined(problem, a, b, rhs, stencil=stencil,
-                         interpret=interpret)
+                         interpret=interpret, history=history)

@@ -31,6 +31,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs.convergence import (
+    history_init,
+    history_record,
+    trace_of,
+)
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.ops.stencil import apply_a_block, apply_dinv, diag_d_block
 from poisson_ellipse_tpu.parallel.compat import pcast_varying, shard_map
@@ -98,10 +103,12 @@ def _shard_ops(problem: Problem, px: int, py: int, bm: int, bn: int,
 
 
 def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
-                pdot, d, rhs_blk, dtype):
+                pdot, d, rhs_blk, dtype, history: bool = False):
     """The full PCG carry at iteration 0 on one shard — layout matches
     ``solver.pcg.init_state`` (k, w, r, p, zr, diff, converged,
-    breakdown), with w/r/p as per-shard blocks and replicated scalars."""
+    breakdown), with w/r/p as per-shard blocks and replicated scalars.
+    ``history=True`` appends the four ``obs.convergence`` buffers —
+    scattered from psum-reduced scalars, so they stay replicated too."""
     # the zeros literal is device-invariant; mark it varying over the mesh so
     # the while_loop carry type matches the (varying) per-device updates
     w0 = pcast_varying(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y))
@@ -109,7 +116,7 @@ def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
     z0 = apply_dinv(r0, d)
     p0 = z0
     zr0 = pdot(z0, r0)
-    return (
+    state = (
         jnp.asarray(0, jnp.int32),
         w0,
         r0,
@@ -119,14 +126,19 @@ def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
         jnp.asarray(False),
         jnp.asarray(False),
     )
+    if history:
+        state = state + history_init(problem.max_iterations, dtype)
+    return state
 
 
 def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
-                   limit=None):
+                   limit=None, history: bool = False):
     """Advance the sharded PCG carry until convergence/breakdown or
     iteration ``limit`` (defaults to max_iterations). Chunking only moves
     the while_loop boundary, not the arithmetic — same contract as
-    ``solver.pcg.advance``."""
+    ``solver.pcg.advance`` (including the history contract: recording is
+    pure extra stores of already-psum-reduced scalars — no additional
+    collectives, no host traffic)."""
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     delta = jnp.asarray(problem.delta, dtype)
@@ -140,11 +152,11 @@ def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
     )
 
     def cond(state):
-        k, _w, _r, _p, _zr, _diff, converged, breakdown = state
+        k, converged, breakdown = state[0], state[6], state[7]
         return (k < max_iter) & ~converged & ~breakdown
 
     def body(state):
-        k, w, r, p, zr, _diff, _c, _bd = state
+        k, w, r, p, zr, _diff, _c, _bd = state[:8]
         ap = stencil(p)
         denom = pdot(ap, p)
         breakdown = denom < DENOM_GUARD
@@ -171,26 +183,39 @@ def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
         r_out = jnp.where(breakdown, r, r_new)
         p_out = jnp.where(breakdown | converged, p, p_new)
         zr_out = jnp.where(breakdown | converged, zr, zr_new)
-        return (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
+        out = (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
+        if history:
+            # applied α is 0 on a breakdown iteration (update discarded)
+            # — the same recording every engine's trace uses
+            out = out + history_record(
+                state[8:], k, zr_new, diff,
+                jnp.where(breakdown, 0.0, alpha), beta,
+            )
+        return out
 
     return lax.while_loop(cond, body, state)
 
 
 def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
                a_ext, b_ext, rhs_blk, dtype, stencil_impl: str = "xla",
-               interpret: bool = False):
+               interpret: bool = False, history: bool = False):
     """Per-device whole solve (init + advance to the iteration cap).
     Runs inside shard_map; a_ext/b_ext are the device's halo-extended
     (bm+2, bn+2) coefficient blocks, rhs_blk its owned (bm, bn) RHS
-    block."""
+    block. With ``history`` the four replicated (cap,) trace buffers
+    ride at the end of the returned tuple."""
     stencil, pdot, d = _shard_ops(
         problem, px, py, bm, bn, a_ext, b_ext, dtype, stencil_impl, interpret
     )
-    state0 = _shard_init(problem, px, py, bm, bn, pdot, d, rhs_blk, dtype)
-    k, w, _r, _p, _zr, diff, converged, breakdown = _shard_advance(
-        problem, stencil, pdot, d, state0, dtype
+    state0 = _shard_init(
+        problem, px, py, bm, bn, pdot, d, rhs_blk, dtype, history=history
     )
-    return w, k, diff, converged, breakdown
+    out = _shard_advance(
+        problem, stencil, pdot, d, state0, dtype, history=history
+    )
+    k, w = out[0], out[1]
+    diff, converged, breakdown = out[5], out[6], out[7]
+    return (w, k, diff, converged, breakdown) + tuple(out[8:])
 
 
 def build_sharded_solver(
@@ -199,8 +224,15 @@ def build_sharded_solver(
     dtype=jnp.float32,
     assembly_mode: str = "host",
     stencil_impl: str = "xla",
+    history: bool = False,
 ):
     """Return (jitted solver_fn, args) for the mesh-sharded solve.
+
+    ``history=True`` (classical loops only — "xla"/"pallas") makes the
+    solver return ``(PCGResult, obs.ConvergenceTrace)``: the
+    per-iteration (zr, diff, α, β) series recorded on device from the
+    already-psum-reduced scalars — zero extra collectives, zero host
+    traffic inside the loop.
 
     assembly_mode:
       "host"   — coefficients assembled once on the host in f64, cast, and
@@ -229,6 +261,13 @@ def build_sharded_solver(
     """
     if mesh is None:
         mesh = make_mesh()
+    if history and stencil_impl not in ("xla", "pallas"):
+        raise ValueError(
+            "history capture covers the classical sharded loops "
+            f"('xla'/'pallas'); got stencil_impl={stencil_impl!r} — the "
+            "fused/pipelined sharded iterations keep their scalars inside "
+            "kernels/recurrences with their own carry layouts"
+        )
     if stencil_impl == "pipelined":
         # the one-collective iteration — its own recurrence and carry
         # layout live in parallel.pipelined_sharded
@@ -265,6 +304,8 @@ def build_sharded_solver(
     g1p, g2p = padded_dims(problem.node_shape, mesh)
     bm, bn = g1p // px, g2p // py
     spec = P(AXIS_X, AXIS_Y)
+    # the four replicated (cap,) trace buffers, when history rides along
+    out_specs = (spec, P(), P(), P(), P()) + ((P(),) * 4 if history else ())
 
     if assembly_mode == "host":
 
@@ -276,6 +317,7 @@ def build_sharded_solver(
             return _local_pcg(
                 problem, px, py, bm, bn, a_ext, b_ext, rhs_blk, dtype,
                 stencil_impl=stencil_impl, interpret=interpret,
+                history=history,
             )
 
         # check_vma off only for the interpret-mode pallas stencil: its
@@ -286,7 +328,7 @@ def build_sharded_solver(
             shard_fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),
-            out_specs=(spec, P(), P(), P(), P()),
+            out_specs=out_specs,
             check_vma=not (stencil_impl == "pallas" and interpret),
         )
 
@@ -305,13 +347,14 @@ def build_sharded_solver(
             return _local_pcg(
                 problem, px, py, bm, bn, a_ext, b_ext, rhs_blk, dtype,
                 stencil_impl=stencil_impl, interpret=interpret,
+                history=history,
             )
 
         mapped = shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(),
-            out_specs=(spec, P(), P(), P(), P()),
+            out_specs=out_specs,
             check_vma=not (stencil_impl == "pallas" and interpret),
         )
         args = ()
@@ -319,14 +362,18 @@ def build_sharded_solver(
         raise ValueError(f"unknown assembly_mode: {assembly_mode!r}")
 
     def solver(*arrays):
-        w_pad, k, diff, converged, breakdown = mapped(*arrays)
-        return PCGResult(
+        out = mapped(*arrays)
+        w_pad, k, diff, converged, breakdown = out[:5]
+        result = PCGResult(
             w=w_pad[: problem.M + 1, : problem.N + 1],
             iters=k,
             diff=diff,
             converged=converged,
             breakdown=breakdown,
         )
+        if history:
+            return result, trace_of(out[5:], k)
+        return result
 
     return jax.jit(solver), args
 
@@ -438,10 +485,13 @@ def solve_sharded(
     dtype=jnp.float32,
     assembly_mode: str = "host",
     stencil_impl: str = "xla",
-) -> PCGResult:
-    """Assemble, shard and solve over the mesh (all devices by default)."""
+    history: bool = False,
+):
+    """Assemble, shard and solve over the mesh (all devices by default).
+    ``history=True`` returns (PCGResult, obs.ConvergenceTrace)."""
     solver, args = build_sharded_solver(
-        problem, mesh, dtype, assembly_mode, stencil_impl=stencil_impl
+        problem, mesh, dtype, assembly_mode, stencil_impl=stencil_impl,
+        history=history,
     )
     return solver(*args)
 

@@ -92,13 +92,23 @@ def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
 
 
 def build_solver(
-    problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None
+    problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
+    history: bool = False,
 ):
     """(jitted solver, args, resolved_engine) for a single-chip solve.
 
     All engines share the PCGResult contract and the f64-host-assembled,
     rounded-once operand fidelity, so swapping engines changes speed, not
     iteration counts (verified against the published oracles).
+
+    ``history=True`` builds the solver in convergence-telemetry form: it
+    returns ``(PCGResult, obs.ConvergenceTrace)`` with the per-iteration
+    (zr, diff, α, β) series recorded on device (``obs.convergence``).
+    Supported by the XLA-loop engines (xla, pallas, fused, pipelined,
+    pipelined-pallas) — the VMEM mega-kernel engines (resident, streamed,
+    xl) keep their scalars in kernel scratch, so "auto" with history
+    resolves to xla (the reference-trajectory engine) and an explicit
+    mega-kernel request fails loudly.
 
     "auto" degrades gracefully: the capacity gates are budgets measured
     on the bench part, so on a chip with a different VMEM size a selected
@@ -107,6 +117,17 @@ def build_solver(
     cannot fail this way) instead of surfacing an opaque compile error.
     Explicitly requested engines still fail loudly.
     """
+    if engine == "auto" and history:
+        # the mega-kernel engines auto would pick cannot record: take the
+        # reference-trajectory engine instead of failing a telemetry ask
+        engine = "xla"
+    if history and engine in ("resident", "streamed", "xl"):
+        raise ValueError(
+            f"engine {engine!r} keeps its scalar recurrence in VMEM kernel "
+            "scratch and cannot record history; use xla/pallas/fused/"
+            "pipelined/pipelined-pallas (or engine='auto', which resolves "
+            "to xla under history=True)"
+        )
     if engine == "auto":
         import jax
 
@@ -152,7 +173,9 @@ def build_solver(
     elif engine == "fused":
         from poisson_ellipse_tpu.ops.fused_pcg import build_fused_solver
 
-        solver, args = build_fused_solver(problem, dtype, interpret=interpret)
+        solver, args = build_fused_solver(
+            problem, dtype, interpret=interpret, history=history
+        )
     elif engine == "xl":
         from poisson_ellipse_tpu.ops.xl_pcg import build_xl_solver
 
@@ -167,7 +190,8 @@ def build_solver(
         # no donation: same build-once-call-many contract as the xla path
         solver = jax.jit(  # tpulint: disable=TPU004
             lambda a, b, rhs: pcg_pipelined(
-                problem, a, b, rhs, stencil=stencil, interpret=interpret
+                problem, a, b, rhs, stencil=stencil, interpret=interpret,
+                history=history,
             )
         )
         args = (a, b, rhs)
@@ -181,7 +205,9 @@ def build_solver(
         # no donation: the build-once-call-many contract re-feeds these
         # operands on every dispatch (bench --repeat, chained solves)
         solver = jax.jit(  # tpulint: disable=TPU004
-            lambda a, b, rhs: pcg(problem, a, b, rhs, stencil=stencil)
+            lambda a, b, rhs: pcg(
+                problem, a, b, rhs, stencil=stencil, history=history
+            )
         )
         args = (a, b, rhs)
     else:
@@ -190,8 +216,15 @@ def build_solver(
 
 
 def solve(
-    problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None
-) -> PCGResult:
-    """Assemble and solve single-chip with the selected engine."""
-    solver, args, _ = build_solver(problem, engine, dtype, interpret=interpret)
+    problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
+    history: bool = False,
+):
+    """Assemble and solve single-chip with the selected engine.
+
+    ``history=True`` returns ``(PCGResult, obs.ConvergenceTrace)`` — the
+    on-device per-iteration convergence telemetry (see ``build_solver``).
+    """
+    solver, args, _ = build_solver(
+        problem, engine, dtype, interpret=interpret, history=history
+    )
     return solver(*args)

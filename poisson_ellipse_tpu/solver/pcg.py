@@ -31,6 +31,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs.convergence import (
+    history_init,
+    history_record,
+    trace_of,
+)
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.ops.reduction import grid_dot, grid_dots
 from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
@@ -49,12 +54,14 @@ class PCGResult(NamedTuple):
     breakdown: jax.Array
 
 
-def init_state(problem: Problem, a, b, rhs):
+def init_state(problem: Problem, a, b, rhs, history: bool = False):
     """The PCG carry at iteration 0 (the resumable solver state).
 
     Layout: (k, w, r, p, zr, diff, converged, breakdown) — everything the
     loop needs to continue, so a saved state resumes bit-identically
-    (solver.checkpoint builds on this).
+    (solver.checkpoint builds on this). With ``history=True`` the four
+    ``obs.convergence`` buffers ((cap,) each) ride appended to the core
+    carry; the core layout is untouched.
     """
     dtype = rhs.dtype
     h1 = jnp.asarray(problem.h1, dtype)
@@ -63,7 +70,7 @@ def init_state(problem: Problem, a, b, rhs):
     r0 = rhs
     z0 = apply_dinv(r0, d)
     zr0 = grid_dot(z0, r0, h1, h2)
-    return (
+    state = (
         jnp.asarray(0, jnp.int32),
         jnp.zeros_like(rhs),
         r0,
@@ -73,14 +80,25 @@ def init_state(problem: Problem, a, b, rhs):
         jnp.asarray(False),
         jnp.asarray(False),
     )
+    if history:
+        state = state + history_init(problem.max_iterations, dtype)
+    return state
 
 
-def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"):
+def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla",
+            history: bool = False):
     """Advance the PCG carry until convergence/breakdown or iteration
     ``limit`` (defaults to max_iterations). Returns the new carry.
 
     Running in chunks (limit=k, k+K, …) is bit-identical to one straight
     run: chunking only moves the while_loop boundary, not the arithmetic.
+
+    ``history=True`` expects/returns the extended carry of
+    ``init_state(..., history=True)`` and scatters each iteration's
+    (zr, diff, α, β) into the appended ``obs.convergence`` buffers —
+    pure extra on-device stores, so the iterate trajectory is
+    bit-identical to ``history=False`` (and with it off, the traced
+    computation is exactly the historyless one: jaxpr-pinned).
     """
     dtype = rhs.dtype
     h1 = jnp.asarray(problem.h1, dtype)
@@ -109,11 +127,11 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
     d = diag_d(a, b, h1, h2)
 
     def cond(state):
-        k, _w, _r, _p, _zr, _diff, converged, breakdown = state
+        k, converged, breakdown = state[0], state[6], state[7]
         return (k < max_iter) & ~converged & ~breakdown
 
     def body(state):
-        k, w, r, p, zr, _diff, _c, _bd = state
+        k, w, r, p, zr, _diff, _c, _bd = state[:8]
         ap = apply_stencil(p)
         denom = grid_dot(ap, p, h1, h2)
         breakdown = denom < DENOM_GUARD
@@ -149,20 +167,33 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
         r_out = jnp.where(breakdown, r, r_new)
         p_out = jnp.where(breakdown | converged, p, p_new)
         zr_out = jnp.where(breakdown | converged, zr, zr_new)
-        return (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
+        out = (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
+        if history:
+            # raw zr/β, carry-held diff, applied α (0 on a breakdown
+            # iteration, whose update is discarded — every engine's trace
+            # reports the same thing for the same event) —
+            # obs.convergence's recording contract; pure stores, no
+            # effect on the iterates
+            out = out + history_record(
+                state[8:], k, zr_new, diff,
+                jnp.where(breakdown, 0.0, alpha), beta,
+            )
+        return out
 
     return lax.while_loop(cond, body, state)
 
 
 def result_of(state) -> PCGResult:
-    """View a PCG carry as a PCGResult."""
-    k, w, _r, _p, _zr, diff, converged, breakdown = state
+    """View a PCG carry (core or history-extended) as a PCGResult."""
+    k, w = state[0], state[1]
+    diff, converged, breakdown = state[5], state[6], state[7]
     return PCGResult(
         w=w, iters=k, diff=diff, converged=converged, breakdown=breakdown
     )
 
 
-def pcg(problem: Problem, a, b, rhs, stencil: str = "xla"):
+def pcg(problem: Problem, a, b, rhs, stencil: str = "xla",
+        history: bool = False):
     """Run PCG for pre-assembled coefficients. All inputs (M+1, N+1).
 
     Jit-safe with ``problem`` static; the while_loop carries
@@ -172,14 +203,23 @@ def pcg(problem: Problem, a, b, rhs, stencil: str = "xla"):
     explicit VMEM-tiled kernel, ``ops.pallas_kernels.apply_a_pallas``).
     The two agree to 1-2 ulps — not bitwise — so iteration counts may
     differ by a step on ill-conditioned grids.
+
+    history=True returns ``(PCGResult, obs.ConvergenceTrace)`` — the
+    per-iteration (zr, diff, α, β) series captured on device with zero
+    extra host syncs; the iterates are bit-identical either way.
     """
     state = advance(
-        problem, a, b, rhs, init_state(problem, a, b, rhs), stencil=stencil
+        problem, a, b, rhs, init_state(problem, a, b, rhs, history=history),
+        stencil=stencil, history=history,
     )
-    return result_of(state)
+    result = result_of(state)
+    if history:
+        return result, trace_of(state[8:], result.iters)
+    return result
 
 
-def solve(problem: Problem, dtype=jnp.float32, stencil: str = "xla") -> PCGResult:
+def solve(problem: Problem, dtype=jnp.float32, stencil: str = "xla",
+          history: bool = False):
     """Assemble and solve on a single chip (the stage0-shaped entry point)."""
     a, b, rhs = assembly.assemble(problem, dtype)
-    return pcg(problem, a, b, rhs, stencil=stencil)
+    return pcg(problem, a, b, rhs, stencil=stencil, history=history)

@@ -19,6 +19,12 @@ it would destroy the very fusion being measured. So timing splits in two:
   iteration that times each constituent op (halo, stencil, dot, precond,
   update) in isolation over k repetitions — the analog of stage4's
   per-phase accumulators, measured without slowing the production loop.
+
+``PhaseTimer`` is a thin shim over the structured trace layer
+(``obs.trace``): every region it closes is also emitted as a ``span``
+record (``phase:<name>``) into the ambient JSONL trace when one is
+active, so the human report and the machine trace come from the same
+measurement.
 """
 
 from __future__ import annotations
@@ -28,6 +34,8 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+
+from poisson_ellipse_tpu.obs import trace as _trace
 
 
 def fence(tree) -> None:
@@ -62,11 +70,23 @@ class PhaseTimer:
 
     def add(self, name: str, seconds: float) -> None:
         self.totals[name] = self.totals.get(name, 0.0) + seconds
+        # the shim half: the same measurement lands in the JSONL trace
+        # (no-op when tracing is inactive)
+        _trace.span_event(f"phase:{name}", seconds)
 
     def report(self, out=None) -> str:
+        """Name-sorted rows with a share-of-total column.
+
+        Stable column order (sorted by phase name, not insertion) and a
+        guarded percentage — 0 phases or an all-zero total must render,
+        not divide by zero — so reports derived from two traces of the
+        same run diff cleanly.
+        """
+        total = sum(self.totals.values())
         lines = [
-            f"  T_{name:<10s} {secs:10.4f} s"
-            for name, secs in self.totals.items()
+            f"  T_{name:<10s} {self.totals[name]:10.4f} s  "
+            f"{(100.0 * self.totals[name] / total) if total > 0 else 0.0:5.1f}%"
+            for name in sorted(self.totals)
         ]
         text = "\n".join(lines)
         if out is not None:

@@ -244,67 +244,33 @@ def test_multichip_scaling_table_runs_pipelined():
     )
 
 
-# ---------------------------------------------------- structural (jaxpr)
-
-
-def _subjaxprs(eqn):
-    for v in eqn.params.values():
-        vals = v if isinstance(v, (list, tuple)) else [v]
-        for x in vals:
-            if hasattr(x, "eqns"):
-                yield x
-            elif hasattr(x, "jaxpr") and hasattr(x.jaxpr, "eqns"):
-                yield x.jaxpr
-
-
-def _count_prims(jx, name):
-    n = 0
-    for eqn in jx.eqns:
-        if eqn.primitive.name == name:
-            n += 1
-        for sub in _subjaxprs(eqn):
-            n += _count_prims(sub, name)
-    return n
-
-
-def while_body_psum_counts(fn, args):
-    """psum-eqn count inside each while_loop body of fn's jaxpr."""
-    jaxpr = jax.make_jaxpr(fn)(*args)
-    out = []
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "while":
-                body = eqn.params["body_jaxpr"]
-                out.append(
-                    _count_prims(
-                        body.jaxpr if hasattr(body, "jaxpr") else body, "psum"
-                    )
-                )
-            else:
-                for sub in _subjaxprs(eqn):
-                    walk(sub)
-
-    walk(jaxpr.jaxpr)
-    return out
+# ------------------------------------------------ structural (static cost)
 
 
 def test_pipelined_iteration_issues_exactly_one_psum():
-    """THE structural claim, pinned from the jaxpr: the pipelined sharded
-    loop body holds exactly 1 psum collective; the classical sharded loop
-    body holds 2. (Halo ppermutes are unaffected; the replacement branch
-    adds none.)"""
-    from poisson_ellipse_tpu.parallel.pcg_sharded import build_sharded_solver
-    from poisson_ellipse_tpu.parallel.pipelined_sharded import (
-        build_pipelined_sharded_solver,
-    )
+    """THE structural claim, asserted from the product metric
+    (``obs.static_cost.engine_report`` — the same accounting ``harness
+    inspect`` and the BENCH artifact carry, not a test-local jaxpr
+    walk): the pipelined sharded loop body holds exactly 1 psum
+    collective per iteration; the classical sharded loop holds 2. (Halo
+    ppermutes are unaffected; the replacement branch adds none.)"""
+    from poisson_ellipse_tpu.obs.static_cost import engine_report
 
-    mesh = mesh_of(4)
     problem = Problem(M=40, N=40)
-    pipe_solver, pipe_args = build_pipelined_sharded_solver(problem, mesh)
-    assert while_body_psum_counts(pipe_solver, pipe_args) == [1]
-    xla_solver, xla_args = build_sharded_solver(problem, mesh)
-    assert while_body_psum_counts(xla_solver, xla_args) == [2]
+    pipe = engine_report(
+        problem, "pipelined", mode="sharded", mesh_shape=(2, 2),
+        with_xla_cost=False,
+    )
+    classical = engine_report(
+        problem, "xla", mode="sharded", mesh_shape=(2, 2),
+        with_xla_cost=False,
+    )
+    assert pipe["psum_per_iter"] == 1
+    assert classical["psum_per_iter"] == 2
+    # the halo ring is 4 ppermutes either way (the classical count; the
+    # pipelined body adds the replacement branch's stacked exchanges,
+    # which are static upper-bound accounting, not steady-state cost)
+    assert classical["ppermute_per_iter"] == 4
 
 
 # ------------------------------------------------------------ grid_dots

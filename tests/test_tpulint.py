@@ -27,8 +27,8 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_seven_rules():
-    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 8)]
+def test_registry_has_all_eight_rules():
+    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 9)]
     for code, rule in RULES.items():
         assert rule.code == code
         assert rule.name and rule.summary
@@ -487,6 +487,219 @@ def test_tpu007_pyproject_roots_loaded():
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     config = load_config(repo_root)
     assert "*.ops.reduction.grid_dot" in config.reduction_roots
+
+
+# -- TPU008: host syncs / host callbacks inside loop bodies -----------------
+
+
+def only_008(src: str, **cfg) -> list[str]:
+    cfg.setdefault("select", frozenset({"TPU008"}))
+    return codes_of(src, **cfg)
+
+
+def test_tpu008_positive_item_and_device_get_in_loop_body():
+    src = """
+        import jax
+        from jax import lax
+
+        def body(state):
+            k, x = state
+            bad = x.sum().item()
+            jax.device_get(x)
+            return (k + 1, x * bad)
+
+        def run(x0):
+            return lax.while_loop(lambda s: s[0] < 5, body, (0, x0))
+    """
+    codes = only_008(src)
+    assert codes == ["TPU008", "TPU008"]
+
+
+def test_tpu008_positive_callback_registration_in_loop_body():
+    src = """
+        import jax
+        from jax import lax
+
+        def log_it(v):
+            print(v)
+
+        def body(s):
+            jax.debug.callback(log_it, s)
+            return s + 1
+
+        def run(x0):
+            return lax.fori_loop(0, 5, lambda i, s: body(s), x0)
+    """
+    # the per-iteration callback registered inside the fori body closure
+    src2 = """
+        import jax
+        from jax import lax
+
+        def body(i, s):
+            jax.pure_callback(lambda v: v, s, s)
+            return s + 1
+
+        def run(x0):
+            return lax.fori_loop(0, 5, body, x0)
+    """
+    assert only_008(src2) == ["TPU008"]
+
+
+def test_tpu008_positive_float_on_traced_carry():
+    src = """
+        from jax import lax
+
+        def body(i, s):
+            alpha = float(s)
+            return s + alpha
+
+        def run(x0):
+            return lax.fori_loop(0, 5, body, x0)
+    """
+    assert only_008(src) == ["TPU008"]
+
+
+def test_tpu008_positive_fence_wrapper_in_host_measurement_loop():
+    src = """
+        from poisson_ellipse_tpu.utils.timing import fence
+
+        def measure(solver, args, repeat):
+            times = []
+            for _ in range(repeat):
+                out = solver(*args)
+                fence(out)
+                times.append(1.0)
+            return times
+    """
+    assert only_008(src) == ["TPU008"]
+
+
+def test_tpu008_negative_host_side_fence_outside_loops():
+    # a fence after a single dispatch (warm-up, result fetch) is the
+    # host-side idiom, not a per-iteration sync
+    src = """
+        from poisson_ellipse_tpu.utils.timing import fence
+
+        def warmup(solver, args):
+            out = solver(*args)
+            fence(out)
+            return out
+    """
+    assert only_008(src) == []
+
+
+def test_tpu008_owns_loop_bodies_no_tpu003_double_report():
+    # one defect, one code: a sync inside a loop body is TPU008 only —
+    # TPU003 keeps the jit-def/jit-call surface (suppressing the one
+    # reported code must actually silence the gate)
+    src = """
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def run(x):
+            def body(s):
+                s.item()
+                return s * 0.5
+            return lax.while_loop(lambda s: s.sum() > 1, body, x)
+    """
+    assert codes_of(src) == ["TPU008"]
+    # the jit-def surface outside the loop body stays TPU003
+    src2 = """
+        import jax
+
+        @jax.jit
+        def hot(x):
+            x.block_until_ready()
+            return x
+    """
+    assert codes_of(src2) == ["TPU003"]
+
+
+def test_tpu008_negative_untainted_numpy_in_loop_body():
+    # np.asarray of a host constant inside a loop body is trace-time
+    # constant folding, not a per-iteration sync — same taint semantics
+    # as TPU003 (the classifier is shared, so they cannot drift)
+    src = """
+        import numpy as np
+        from jax import lax
+
+        TABLE = [1.0, 2.0]
+
+        def body(i, s):
+            c = np.asarray(TABLE)
+            return s + c[0]
+
+        def run(x0):
+            return lax.fori_loop(0, 5, body, x0)
+    """
+    assert only_008(src) == []
+    src_tainted = """
+        import numpy as np
+        from jax import lax
+
+        def body(i, s):
+            c = np.asarray(s)
+            return s + c[0]
+
+        def run(x0):
+            return lax.fori_loop(0, 5, body, x0)
+    """
+    assert only_008(src_tainted) == ["TPU008"]
+
+
+def test_tpu008_negative_device_resident_body_stays_silent():
+    # the obs.convergence idiom: per-iteration scalars scattered into an
+    # on-device buffer — exactly what the rule steers people toward
+    src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(state):
+            k, x, hist = state
+            zr = jnp.sum(x * x)
+            hist = lax.dynamic_update_slice(hist, jnp.reshape(zr, (1,)), (k,))
+            return (k + 1, x * 0.5, hist)
+
+        def run(x0, hist0):
+            return lax.while_loop(lambda s: s[0] < 5, body, (0, x0, hist0))
+    """
+    assert only_008(src) == []
+
+
+def test_tpu008_suppression_and_config_knob():
+    src = """
+        from poisson_ellipse_tpu.utils.timing import fence
+
+        def measure(solver, args, repeat):
+            for _ in range(repeat):
+                out = solver(*args)
+                fence(out)  # tpulint: disable=TPU008
+            return out
+    """
+    assert only_008(src) == []
+    # a project can point host-sync-fns at its own wrapper name
+    src2 = """
+        from mylib.sync import wait_for
+
+        def measure(solver, args, repeat):
+            for _ in range(repeat):
+                out = solver(*args)
+                wait_for(out)
+            return out
+    """
+    assert only_008(src2, host_sync_fns=("mylib.sync.wait_for",)) == ["TPU008"]
+    assert only_008(src2, host_sync_fns=()) == []
+
+
+def test_tpu008_pyproject_sync_fns_loaded():
+    import os
+
+    from poisson_ellipse_tpu.lint import load_config
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = load_config(repo_root)
+    assert "*.timing.fence" in config.host_sync_fns
 
 
 # -- plumbing: suppression scope, CLI, report -------------------------------

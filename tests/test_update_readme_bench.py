@@ -78,6 +78,46 @@ def test_pipelined_row_rendered_when_present(workspace):
     assert "pipelined" not in readme.read_text()
 
 
+def test_observability_fields_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        convergence={
+            "grid": [100, 200], "engine": "xla", "iters": 42,
+            "converged": True, "diff_first": 0.02, "diff_final": 9.7e-7,
+            "zr_first": 1e-3, "zr_final": 1e-14,
+        },
+        collectives={
+            "available": True, "grid": [40, 40], "mesh": [1, 2],
+            "engines": {
+                "xla": {"psum_per_iter": 2, "ppermute_per_iter": 4},
+                "pipelined": {"psum_per_iter": 1, "ppermute_per_iter": 12},
+            },
+        },
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "42 iterations traced" in text
+    assert "2.0e-02 → 9.7e-07" in text
+    assert "**2** psum/iteration, pipelined **1**" in text
+    assert "obs.static_cost" in text
+
+
+def test_observability_fields_absent_is_supported(workspace):
+    # pre-obs artifacts (no convergence/collectives keys) and skipped
+    # accounting (available: false) both render without the lines
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "iterations traced" not in text
+    assert "psum/iteration" not in text
+    artifact.write_text(
+        json.dumps(make_artifact(collectives={"available": False}))
+    )
+    urb.regenerate(str(readme), str(artifact))
+    assert "psum/iteration" not in readme.read_text()
+
+
 README_STUB = """# stub
 
 <!-- bench:headline -->
