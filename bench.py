@@ -308,6 +308,63 @@ def bench_convergence(grid: tuple[int, int] = (400, 600), oracle: int = 546):
     return row, ok
 
 
+def bench_recovery(grid: tuple[int, int] = (400, 600), oracle: int = 546):
+    """Resilience row for the artifact: one guarded solve with a NaN
+    injected into the carried residual mid-solve (``resilience.guard`` +
+    ``resilience.faultinject``). The guard must detect it from the
+    per-chunk health word, apply the direction-preserving true-residual
+    restart, and reconverge to oracle parity (±2) — the detect-and-
+    correct property, regression-checked in every artifact."""
+    from poisson_ellipse_tpu.resilience import (
+        FaultPlan,
+        SolveError,
+        guarded_solve,
+        inject_nan,
+    )
+
+    import jax.numpy as jnp
+
+    M, N = grid
+    at = max(oracle // 2, 1)
+    try:
+        guarded = guarded_solve(
+            Problem(M=M, N=N), "xla", jnp.float32, chunk=64,
+            faults=FaultPlan(inject_nan(at, "r")),
+        )
+    except SolveError as e:
+        note(
+            f"  [recovery] {M}x{N} nan@{at}: solve aborted "
+            f"({e.classification}) — recovery FAILED"
+        )
+        return {
+            "grid": [M, N], "engine": "xla", "fault": "nan", "at": at,
+            "converged": False, "aborted": e.classification,
+        }, False
+    n = int(guarded.result.iters)
+    kinds = [event.kind for event in guarded.recoveries]
+    ok = (
+        bool(guarded.result.converged)
+        and abs(n - oracle) <= 2
+        and kinds == ["residual-restart"]
+    )
+    row = {
+        "grid": [M, N],
+        "engine": "xla",
+        "fault": "nan",
+        "at": at,
+        "iters": n,
+        "clean_iters": oracle,
+        "converged": bool(guarded.result.converged),
+        "recoveries": kinds,
+    }
+    note(
+        f"  [recovery] {M}x{N} nan@{at}: {n} iterations "
+        f"(clean oracle {oracle}), recoveries={kinds} "
+        + ("— OK (oracle parity after recovery)" if ok else "— PARITY MISS"),
+    )
+    return row, ok
+
+
 def bench_collectives():
     """Static collective accounting for the artifact: psum/ppermute per
     iteration read from the jaxpr (``obs.static_cost``) on a 1×2 mesh of
@@ -369,7 +426,10 @@ def main() -> int:
     # on-device convergence telemetry + static collective accounting
     conv_row, okc = bench_convergence()
     coll_table, okl = bench_collectives()
-    all_ok &= ok2 & okn & ok8 & okp & oke & okc & okl
+    # resilience row: an injected NaN mid-solve must recover to oracle
+    # parity through the guard (f32, before the f64 flip below)
+    rec_row, okr = bench_recovery()
+    all_ok &= ok2 & okn & ok8 & okp & oke & okc & okl & okr
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
     okf, f64_row = bench_f64_row()
@@ -396,6 +456,9 @@ def main() -> int:
         # static psum/ppermute accounting: the pipelined-1-vs-classical-2
         # property as a regression-checked artifact metric
         "collectives": coll_table,
+        # guarded-solve fault drill: injected NaN -> residual restart ->
+        # oracle-parity reconvergence (resilience.guard)
+        "recovery": rec_row,
         "f64": f64_row,
     }
     trace_event("bench_artifact", **record)

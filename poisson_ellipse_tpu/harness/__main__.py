@@ -17,6 +17,21 @@ Two observability entries ride the same prog:
   FLOPs/HBM bytes, the roofline traffic model's columns) with no solve
   executed — ``python -m poisson_ellipse_tpu.harness inspect pipelined
   --mode sharded --mesh 1 2``.
+
+And the resilience surface:
+
+- ``--guard`` routes the solve through ``resilience.guard`` (chunked
+  execution, per-chunk health word, recovery ladder); ``--timeout S``
+  implies it and cancels gracefully at a chunk boundary, emitting the
+  partial trace instead of hanging.
+- ``inject <fault>`` is the chaos subcommand: run a guarded solve with a
+  deterministic fault (nan / breakdown / stagnation / halo / oom)
+  injected at an exact iteration and report the recovery —
+  ``python -m poisson_ellipse_tpu.harness inject nan 40 40 --at 10``.
+- Exit codes are a contract: 0 converged, 1 iteration cap without
+  convergence, 2 diverged (breakdown / recovery exhausted; also invalid
+  invocations, per argparse convention), 3 device out-of-memory with no
+  engine left to degrade to, 4 ``--timeout`` exceeded.
 """
 
 from __future__ import annotations
@@ -35,8 +50,17 @@ from poisson_ellipse_tpu.harness.run import (
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.obs import metrics as obs_metrics
 from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.resilience.errors import SolveError
 from poisson_ellipse_tpu.runtime.native import NativeBuildError
 from poisson_ellipse_tpu.solver.engine import ENGINES
+
+EXIT_CODES_HELP = (
+    "exit codes (contract): 0 converged; 1 iteration cap reached without "
+    "convergence; 2 diverged — breakdown or recovery budget exhausted "
+    "(also invalid invocations, per argparse convention); 3 device "
+    "out-of-memory with no engine left to degrade to; 4 --timeout "
+    "exceeded (partial trace artifact emitted)."
+)
 
 
 def _parse_grids(args) -> list[tuple[int, int]]:
@@ -143,13 +167,132 @@ def _run_inspect(argv: list[str]) -> int:
     return 0
 
 
+def _run_inject(argv: list[str]) -> int:
+    """The ``inject`` subcommand: one guarded solve with a deterministic
+    fault, reporting the recovery — the recovery paths stay exercised
+    from the command line, not only from the test matrix."""
+    from poisson_ellipse_tpu.resilience import faultinject
+    from poisson_ellipse_tpu.resilience.guard import guarded_solve
+
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness inject",
+        description="Fault-injection harness: run a guarded solve with "
+        "one deterministic fault (resilience.faultinject) and report the "
+        "recovery ladder's actions. " + EXIT_CODES_HELP,
+    )
+    ap.add_argument(
+        "fault", choices=sorted(faultinject.FAULT_KINDS),
+        help="fault class to inject (see resilience.faultinject)",
+    )
+    ap.add_argument("M", type=int, nargs="?", default=40)
+    ap.add_argument("N", type=int, nargs="?", default=None)
+    ap.add_argument(
+        "--at", type=int, default=10, metavar="K",
+        help="iteration to inject at (guard chunks stop exactly there)",
+    )
+    ap.add_argument(
+        "--field", default=None,
+        help="carry field to corrupt (nan/halo faults; default r)",
+    )
+    ap.add_argument(
+        "--persistent", action="store_true",
+        help="re-fire the fault on every visit instead of one-shot — "
+        "forces the guard up the ladder and into the classified error",
+    )
+    ap.add_argument(
+        "--engine", default="xla",
+        choices=("xla", "pallas", "pipelined", "pipelined-pallas"),
+        help="chunk-steppable engine to guard (carry faults need one)",
+    )
+    ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--max-recoveries", type=int, default=3)
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("--delta", type=float, default=1e-6)
+    ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        obs_trace.start(args.trace)
+    # everything past tracer start sits under the finally that stops it:
+    # an invalid fault/problem spec must not leak the process-global
+    # tracer or exit with a raw traceback instead of the contract's 2
+    try:
+        try:
+            problem = Problem(
+                M=args.M, N=args.N if args.N is not None else args.M,
+                delta=args.delta,
+            )
+            plan = faultinject.FaultPlan(faultinject.Fault(
+                args.fault, at_iter=args.at, field=args.field,
+                persistent=args.persistent,
+            ))
+            guarded = guarded_solve(
+                problem, args.engine, resolve_dtype(args.dtype),
+                chunk=args.chunk, max_recoveries=args.max_recoveries,
+                timeout=args.timeout, faults=plan,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        except SolveError as e:
+            record = {
+                "fault": args.fault, "at": args.at, "engine": args.engine,
+                "aborted": e.classification, "iters": e.iters,
+            }
+            obs_trace.event("inject_report", **record)
+            if args.json:
+                print(json.dumps(record))
+            else:
+                print(
+                    f"fault {args.fault}@{args.at}: solve aborted — "
+                    f"{e.classification} ({e}); exit {e.exit_code}",
+                    file=sys.stderr,
+                )
+            return e.exit_code
+        return _report_inject(args, guarded)
+    finally:
+        # stop LAST: every inject_report above must land in the trace
+        if args.trace:
+            obs_trace.stop()
+
+
+def _report_inject(args, guarded) -> int:
+    result = guarded.result
+    record = {
+        "fault": args.fault, "at": args.at,
+        "engine_requested": args.engine, "engine_final": guarded.engine,
+        "dtype_final": guarded.dtype,
+        "iters": int(result.iters), "converged": bool(result.converged),
+        "recoveries": [e.kind for e in guarded.recoveries],
+    }
+    obs_trace.event("inject_report", **record)
+    if args.json:
+        print(json.dumps(record))
+    else:
+        kinds = ", ".join(e.kind for e in guarded.recoveries) or "none"
+        print(
+            f"fault {args.fault}@{args.at} on {args.engine}: "
+            f"{'converged' if record['converged'] else 'NOT converged'} "
+            f"after {record['iters']} iterations "
+            f"(recoveries: {kinds}; finished on {guarded.engine}"
+            + (f", {guarded.dtype}" if guarded.dtype else "")
+            + ")"
+        )
+    return 0 if record["converged"] else 1
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "inspect":
         return _run_inspect(argv[1:])
+    if argv and argv[0] == "inject":
+        return _run_inject(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.harness",
         description="Fictitious-domain Poisson PCG on TPU",
+        epilog=EXIT_CODES_HELP,
     )
     ap.add_argument("M", type=int, nargs="?", help="grid cells in x")
     ap.add_argument("N", type=int, nargs="?", help="grid cells in y")
@@ -229,6 +372,31 @@ def main(argv=None) -> int:
         type=int,
         default=500,
         help="iterations between checkpoints (with --checkpoint-dir)",
+    )
+    ap.add_argument(
+        "--timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="per-solve deadline, enforced at guard chunk boundaries "
+        "(graceful cancel: the in-flight chunk completes, a partial "
+        "schema-valid trace is emitted, exit code 4); implies --guard",
+    )
+    ap.add_argument(
+        "--guard",
+        action="store_true",
+        help="run through resilience.guard: chunked execution with a "
+        "per-chunk device-side health word (breakdown/NaN/stagnation), "
+        "the recovery ladder (residual restart -> f32->f64 escalation "
+        "-> engine fallback), and classified errors instead of NaN "
+        "results",
+    )
+    ap.add_argument(
+        "--max-recoveries",
+        type=int,
+        default=3,
+        help="recovery-action budget for guarded runs before the solve "
+        "is classified diverged (exit code 2)",
     )
     ap.add_argument(
         "--profile",
@@ -361,7 +529,28 @@ def _run_cli(args) -> int:
                         threads=args.threads,
                         checkpoint_dir=ck_dir,
                         chunk=args.chunk,
+                        timeout=args.timeout,
+                        guard=args.guard,
+                        max_recoveries=args.max_recoveries,
                     )
+            except SolveError as e:
+                # the classified exit contract: the trace keeps every
+                # event flushed before the abort (recovery:* included),
+                # plus this partial report — an artifact, not a hang
+                record = {
+                    "M": M, "N": N, "dtype": args.dtype,
+                    "engine": args.engine,
+                    "aborted": e.classification,
+                    "iters": e.iters,
+                }
+                obs_trace.event("run_report_partial", **record)
+                if args.json:
+                    print(json.dumps(record))
+                print(
+                    f"error: solve aborted — {e.classification}: {e}",
+                    file=sys.stderr,
+                )
+                return e.exit_code
             except (ValueError, NativeBuildError) as e:
                 # NativeBuildError = g++ missing or the C++ build failed —
                 # an environment problem to report, not a traceback. Other
@@ -431,8 +620,10 @@ def _run_cli(args) -> int:
                             file=sys.stderr,
                         )
                 print()
-            if not report.converged:
-                rc = 1
+            if report.breakdown:
+                rc = max(rc, 2)  # diverged, per the exit-code contract
+            elif not report.converged:
+                rc = max(rc, 1)
     return rc
 
 

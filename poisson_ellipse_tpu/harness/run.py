@@ -20,11 +20,24 @@ import jax.numpy as jnp
 from jax import lax
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import trace as obs_trace
 from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
 from poisson_ellipse_tpu.parallel.pcg_sharded import build_sharded_solver
+from poisson_ellipse_tpu.resilience.errors import (
+    OutOfMemoryError,
+    is_oom_error,
+)
 from poisson_ellipse_tpu.solver.engine import build_solver
 from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
 from poisson_ellipse_tpu.utils.timing import PhaseTimer, fence
+
+# runtime degradation ladder for `--engine auto`: RESOURCE_EXHAUSTED on
+# the first (compile + warm-up) dispatch walks down one rung per retry;
+# xla has no capacity gate, so the ladder always terminates
+_DEGRADE_LADDER = ("resident", "streamed", "xl", "xla")
+# seconds before re-dispatching after an OOM: gives the allocator a beat
+# to release the failed attempt's buffers before the smaller engine asks
+_DEGRADE_BACKOFF_S = 0.25
 
 DTYPES = {
     "f32": jnp.float32,
@@ -95,6 +108,9 @@ class RunReport:
     # resumed checkpointed run times only the iterations it ran, while
     # ``iters`` stays the solver's cumulative (oracle-checked) count
     timed_iters: int | None = None
+    # recovery actions a guarded run applied (resilience.guard event
+    # kinds, in order); empty = the healthy path ran start to finish
+    recoveries: list[str] = field(default_factory=list)
 
     def summary(self) -> str:
         p = self.problem
@@ -124,6 +140,11 @@ class RunReport:
             ),
             f"L2 error vs analytic: {self.l2_error:.6e}",
         ]
+        if self.recoveries:
+            lines.append(
+                f"Recoveries: {len(self.recoveries)} "
+                f"({', '.join(self.recoveries)})"
+            )
         line = self.roofline_line()
         if line:
             lines.append(line)
@@ -174,6 +195,7 @@ class RunReport:
             "hbm_gbps": self.hbm_gbps,
             "hbm_peak_frac": self.hbm_peak_frac,
             **({"threads": self.threads} if self.engine == "native" else {}),
+            **({"recoveries": self.recoveries} if self.recoveries else {}),
         }
 
 
@@ -188,6 +210,9 @@ def run_once(
     threads: int = 0,
     checkpoint_dir: str | None = None,
     chunk: int = 500,
+    timeout: float | None = None,
+    guard: bool = False,
+    max_recoveries: int = 3,
 ) -> RunReport:
     """Assemble + solve with fenced init/solver timing.
 
@@ -209,10 +234,25 @@ def run_once(
     ``_chain_solver``). Otherwise ``repeat`` measurements of ``batch``
     back-to-back dispatches each; T_solver is the median per-dispatch
     time.
+
+    timeout/guard/max_recoveries: the resilience surface. ``guard=True``
+    (or any ``timeout``) routes the solve through
+    ``resilience.guard.guarded_solve`` — chunked execution, per-chunk
+    health word, the recovery ladder, classified ``SolveError``s instead
+    of NaN results — with plain wall-clock timing (restartable solves
+    are not the bench protocol, same stance as checkpointed runs).
+    ``timeout`` is seconds per solve, cancelled gracefully at a chunk
+    boundary (``SolveTimeout``, exit code 4 in the CLI).
     """
     if mode == "native":
         if checkpoint_dir is not None:
             raise ValueError("checkpointing covers the JAX paths, not native")
+        if timeout is not None or guard:
+            raise ValueError(
+                "--timeout/--guard cover the JAX paths (chunked guarded "
+                "solves); the native host runtime has no chunk boundary "
+                "to cancel or recover at"
+            )
         return _run_native(problem, repeat=repeat, threads=threads)
     jdtype = resolve_dtype(dtype)
     if mode == "auto":
@@ -223,6 +263,21 @@ def run_once(
         )
     if mode not in ("single", "sharded"):
         raise ValueError(f"unknown mode: {mode!r}")
+    if timeout is not None or guard:
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "guarded/timeout runs and checkpointed runs are separate "
+                "chunked drivers; drop --checkpoint-dir or --timeout/--guard"
+            )
+        if repeat > 1 or batch > 1:
+            raise ValueError(
+                "guarded/timeout runs are one wall-clocked chunked solve; "
+                "the repeat/batch timing protocol does not apply"
+            )
+        return _run_guarded(
+            problem, mode, mesh_shape, dtype, jdtype, engine,
+            timeout=timeout, max_recoveries=max_recoveries,
+        )
     if checkpoint_dir is not None:
         if repeat > 1 or batch > 1:
             raise ValueError(
@@ -236,6 +291,7 @@ def run_once(
         )
 
     timer = PhaseTimer()
+    requested_auto = engine == "auto"
     if mode == "single":
         with timer.phase("init"):
             solver, args, engine = build_solver(problem, engine, jdtype)
@@ -263,9 +319,17 @@ def run_once(
         raise ValueError(f"unknown mode: {mode!r}")
 
     # compile + warm-up outside the timed region (the reference likewise
-    # excludes MPI_Init / cudaMalloc from T_solver via its barrier fences)
-    result = solver(*args)
-    fence(result)
+    # excludes MPI_Init / cudaMalloc from T_solver via its barrier fences).
+    # For --engine auto this is also where runtime RESOURCE_EXHAUSTED
+    # degrades down the capacity ladder: the gates are budgets, the
+    # allocator is the judge.
+    if mode == "single":
+        solver, args, engine, result = _warm_with_degradation(
+            problem, jdtype, solver, args, engine, auto=requested_auto
+        )
+    else:
+        result = solver(*args)
+        fence(result)
 
     if batch > 1 and mode == "single":
         # Chained differential protocol: one jitted dispatch runs `batch`
@@ -307,6 +371,88 @@ def run_once(
     return _finish_report(
         problem, shape, dtype, jdtype, engine, result, timer, times
     )
+
+
+def _warm_with_degradation(problem, jdtype, solver, args, engine: str,
+                           auto: bool):
+    """The first (compile + warm-up) dispatch, with the runtime OOM
+    ladder for auto-selected engines.
+
+    The capacity gates are *budgets measured on the bench part*; the
+    allocator on the actual device is the judge. When it rules
+    RESOURCE_EXHAUSTED on an auto pick, the next-smaller engine is built
+    and retried after a short backoff (releasing the failed attempt's
+    buffers first), down to xla — which has no capacity gate. An
+    explicitly requested engine stays loud, but classified: the CLI maps
+    :class:`OutOfMemoryError` to exit code 3.
+    """
+    while True:
+        try:
+            result = solver(*args)
+            # warm-up fence: the sync marks the end of compile+first
+            # dispatch, outside every timed region
+            fence(result)  # tpulint: disable=TPU008
+            return solver, args, engine, result
+        except Exception as e:  # noqa: BLE001 — OOM classified, rest re-raised
+            if not is_oom_error(e):
+                raise
+            if not (auto and engine in _DEGRADE_LADDER[:-1]):
+                raise OutOfMemoryError(
+                    f"engine {engine!r} hit RESOURCE_EXHAUSTED at "
+                    f"warm-up: {e}"
+                ) from e
+            nxt = _DEGRADE_LADDER[_DEGRADE_LADDER.index(engine) + 1]
+            obs_trace.note(
+                f"engine {engine} hit RESOURCE_EXHAUSTED at warm-up; "
+                f"degrading to {nxt} (backoff {_DEGRADE_BACKOFF_S:g}s)",
+                _event="degrade:engine",
+                from_engine=engine,
+                to_engine=nxt,
+            )
+            del solver, args  # release the failed attempt before rebuilding
+            time.sleep(_DEGRADE_BACKOFF_S)
+            solver, args, engine = build_solver(problem, nxt, jdtype)
+
+
+def _run_guarded(
+    problem: Problem,
+    mode: str,
+    mesh_shape,
+    dtype: str,
+    jdtype,
+    engine: str,
+    timeout: float | None,
+    max_recoveries: int,
+) -> RunReport:
+    """One guarded (and/or deadlined) solve through
+    ``resilience.guard.guarded_solve``. Timing is a plain wall clock
+    around the chunked run — resilience trades peak dispatch efficiency
+    for survivability, so this is not the protocol the bench numbers
+    use (the checkpointed driver takes the same stance)."""
+    from poisson_ellipse_tpu.resilience.guard import guarded_solve
+
+    timer = PhaseTimer()
+    with timer.phase("init"):
+        mesh = resolve_mesh(mesh_shape) if mode == "sharded" else None
+        if mode == "sharded" and engine == "auto":
+            engine = "xla"
+    shape = (
+        (mesh.shape[AXIS_X], mesh.shape[AXIS_Y]) if mesh is not None else (1, 1)
+    )
+    t0 = time.perf_counter()
+    guarded = guarded_solve(
+        problem, engine, jdtype, mesh=mesh, timeout=timeout,
+        max_recoveries=max_recoveries,
+    )
+    fence(guarded.result)
+    t_solve = time.perf_counter() - t0
+    timer.add("solver", t_solve)
+    report = _finish_report(
+        problem, shape, dtype, jdtype, guarded.engine, guarded.result,
+        timer, [t_solve],
+    )
+    report.recoveries = [event.kind for event in guarded.recoveries]
+    return report
 
 
 def _chain_solver(solver, args, n: int):

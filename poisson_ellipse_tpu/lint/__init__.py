@@ -8,7 +8,7 @@ caught by hand across five rewrites. tpulint catches them mechanically:
     python -m poisson_ellipse_tpu.lint              # paths from pyproject
     python -m poisson_ellipse_tpu.lint poisson_ellipse_tpu/ops --statistics
 
-Rules are TPU001–TPU008 (see :mod:`.rules`); any finding can be waived
+Rules are TPU001–TPU009 (see :mod:`.rules`); any finding can be waived
 in place with a trailing or preceding-line comment::
 
     x = jnp.zeros(n, jnp.float64)  # tpulint: disable=TPU001
@@ -150,6 +150,9 @@ def load_config(root: Optional[str] = None) -> LintConfig:
         ),
         host_sync_fns=tuple(
             table.get("host-sync-fns", cfg.host_sync_fns)
+        ),
+        reraise_fns=tuple(
+            table.get("reraise-fns", cfg.reraise_fns)
         ),
     )
 

@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU008.
+"""The tpulint rule registry: TPU001–TPU009.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -20,6 +20,10 @@ silent — a lint gate that cries wolf gets deleted from CI.
 | TPU008 | host-sync-in-loop  | host sync / host callback inside a traced loop|
 |        |                    | body, or a fence-wrapper sync in a per-dispatch|
 |        |                    | Python measurement loop                        |
+| TPU009 | swallowed-exception| bare/broad `except` whose handler neither     |
+|        |                    | re-raises nor hands off to a configured       |
+|        |                    | classify-and-re-raise helper — device-runtime |
+|        |                    | errors silently eaten                         |
 """
 
 from __future__ import annotations
@@ -66,6 +70,12 @@ class LintConfig:
     # justified exactly at timing-protocol fences, which carry an
     # annotation saying so.
     host_sync_fns: tuple[str, ...] = ("*.timing.fence", "fence")
+    # TPU009: classify-and-re-raise helpers (resolved-qualname fnmatch
+    # patterns). A broad handler that hands the exception to one of
+    # these is compliant — the helper raises the classified SolveError
+    # on the caller's behalf, so the handler body carries no literal
+    # `raise` of its own.
+    reraise_fns: tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -877,3 +887,107 @@ def check_host_sync_in_loop(module: Module, config: LintConfig) -> Iterator[Find
                 "— annotate it with a note; otherwise hoist the sync out "
                 "and let dispatches pipeline",
             ))
+
+
+# --------------------------------------------------------------------------
+# TPU009 — bare/broad except blocks that swallow device-runtime errors
+# --------------------------------------------------------------------------
+
+_BROAD_EXCEPTION_NAMES = frozenset(
+    {"Exception", "BaseException", "builtins.Exception",
+     "builtins.BaseException"}
+)
+
+
+def _is_broad_handler(module: Module, handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:``, ``except Exception/BaseException``, or a tuple
+    containing either. A *narrow* class the code chose deliberately
+    (ValueError, XlaRuntimeError, ...) is a stated intent and stays
+    silent — the hazard is the catch-all that eats whatever the device
+    runtime throws."""
+    if handler.type is None:
+        return True
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, (ast.Tuple, ast.List))
+        else [handler.type]
+    )
+    for t in types:
+        if (module.qualname(t) or "") in _BROAD_EXCEPTION_NAMES:
+            return True
+    return False
+
+
+def _handler_reraises(module: Module, handler: ast.ExceptHandler,
+                      config: LintConfig) -> bool:
+    """Does the handler body itself re-raise (or call a reraise-fn)?
+
+    Scope-aware: a ``raise`` inside a nested ``def``/``lambda``/class is
+    merely *defined* in the handler, never executed by it — descending
+    into those scopes would let ``except Exception: def f(): raise``
+    pass, which is exactly the swallow the rule fences (same stance as
+    the other rules' traced-scope walks)."""
+    stack: list[ast.AST] = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            continue
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            q = module.qualname(node.func) or ""
+            if q and any(
+                fnmatch.fnmatch(q, pat) for pat in config.reraise_fns
+            ):
+                return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+@rule(
+    "TPU009",
+    "swallowed-exception",
+    "bare/broad `except` whose handler neither re-raises nor calls a "
+    "configured classify-and-re-raise helper",
+)
+def check_swallowed_exception(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """A compiled dispatch fails through exactly one channel: the
+    exception. XLA's RESOURCE_EXHAUSTED, a Mosaic compile error, a
+    poisoned-carry assertion — all arrive as a ``RuntimeError`` a bare
+    ``except`` will happily eat, turning a classifiable failure into a
+    silently wrong or missing result (the reference's CUDA stages check
+    no return codes at all — SURVEY §5; this rule is the regression
+    fence for the opposite stance). A broad handler is compliant when
+    its body re-raises (anything — the classified ``SolveError``
+    taxonomy in ``resilience.errors`` is the house idiom) or hands the
+    exception to a ``reraise-fns``-configured helper; genuinely
+    deliberate swallows (best-effort accounting, report-the-failure
+    rows) carry a ``# tpulint: disable=TPU009`` with a note, exactly
+    like every other waived finding."""
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if not _is_broad_handler(module, handler):
+                continue
+            if _handler_reraises(module, handler, config):
+                continue
+            label = (
+                "bare `except:`"
+                if handler.type is None
+                else f"`except {ast.unparse(handler.type)}`"
+            )
+            yield _finding(
+                module,
+                handler,
+                "TPU009",
+                f"{label} swallows device-runtime errors: OOM, compile "
+                "failures and poisoned-solve exceptions all arrive here "
+                "and vanish — re-raise a classified error "
+                "(resilience.errors.SolveError), call a reraise-fns "
+                "helper, or suppress with a note when the swallow is "
+                "deliberate",
+            )

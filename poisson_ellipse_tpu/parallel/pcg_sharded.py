@@ -467,6 +467,65 @@ def build_sharded_stepper(
     return init_fn, advance_fn
 
 
+def build_sharded_recover(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+    stencil_impl: str = "xla",
+):
+    """Jitted true-residual restart over the sharded carry — the
+    recovery primitive ``resilience.guard`` applies to mesh solves.
+
+    ``recover_fn(state) -> state`` rebuilds r = rhs − A·w on every shard
+    (one halo exchange + block stencil), the preconditioned residual and
+    zr from ground truth, KEEPING the search direction p — the
+    residual-replacement form that preserves oracle iteration parity
+    (see ``resilience.guard``) — and clears the converged/breakdown
+    flags. Same carry layout in and out as ``build_sharded_stepper``, so
+    a recovered carry feeds straight back into ``advance_fn``.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    px = mesh.shape[AXIS_X]
+    py = mesh.shape[AXIS_Y]
+    interpret = mesh.devices.flat[0].platform != "tpu"
+    g1p, g2p = padded_dims(problem.node_shape, mesh)
+    bm, bn = g1p // px, g2p // py
+    spec = P(AXIS_X, AXIS_Y)
+    scalar = P()
+    state_specs = (scalar, spec, spec, spec, scalar, scalar, scalar, scalar)
+
+    def recover_shard(a_blk, b_blk, rhs_blk, state):
+        a_ext = halo_extend(a_blk, px, py)
+        b_ext = halo_extend(b_blk, px, py)
+        stencil, pdot, d = _shard_ops(
+            problem, px, py, bm, bn, a_ext, b_ext, dtype,
+            stencil_impl, interpret,
+        )
+        k, w, _r, p, _zr, diff, _c, _bd = state
+        r2 = rhs_blk - stencil(w)
+        z2 = apply_dinv(r2, d)
+        zr2 = pdot(z2, r2)
+        return (
+            k, w, r2, p, zr2, diff,
+            jnp.asarray(False), jnp.asarray(False),
+        )
+
+    mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
+        recover_shard,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, state_specs),
+        out_specs=state_specs,
+        check_vma=not (stencil_impl == "pallas" and interpret),
+    ))
+    args = _host_sharded_args(problem, mesh, dtype, g1p, g2p, spec)
+
+    def recover_fn(state):
+        return mapped(args[0], args[1], args[2], state)
+
+    return recover_fn
+
+
 def sharded_result_of(problem: Problem, state) -> PCGResult:
     """View a sharded PCG carry as a PCGResult (crops the shard padding)."""
     k, w, _r, _p, _zr, diff, converged, breakdown = state

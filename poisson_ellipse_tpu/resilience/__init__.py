@@ -1,0 +1,82 @@
+"""resilience — guarded solves, classified failures, fault injection.
+
+Three legs, turning "the solver noticed something was wrong" into "the
+service survived it":
+
+- :mod:`.guard` — ``guarded_solve``: any engine's solve run in chunks
+  with a one-word-per-chunk device-side health check (breakdown /
+  NaN-Inf / stagnation) and a recovery ladder — true residual restart
+  (direction-preserving, oracle-parity), f32→f64 precision escalation,
+  engine fallback — capped by ``max_recoveries`` and classified on
+  exhaustion.
+- :mod:`.errors` — the :class:`SolveError` taxonomy and the harness
+  exit-code contract (2 = diverged, 3 = oom, 4 = timeout), plus the one
+  place device-runtime OOM strings are sniffed.
+- :mod:`.faultinject` — deterministic fault injection (NaN into a named
+  carry field at iteration k, forced breakdown, stagnation, halo-slab
+  corruption, simulated OOM, checkpoint truncation, shrunken-VMEM
+  capacity gates), so every recovery path is exercised in tests and via
+  ``harness inject`` — never assumed.
+"""
+
+from poisson_ellipse_tpu.resilience.errors import (
+    EXIT_DIVERGED,
+    EXIT_OOM,
+    EXIT_TIMEOUT,
+    DivergedError,
+    OutOfMemoryError,
+    SolveError,
+    SolveTimeout,
+    classify_error,
+    is_oom_error,
+)
+from poisson_ellipse_tpu.resilience.faultinject import (
+    Fault,
+    FaultPlan,
+    corrupt_halo,
+    force_breakdown,
+    inject_nan,
+    inject_stagnation,
+    simulate_oom,
+    simulated_vmem,
+    truncate_latest_checkpoint,
+)
+from poisson_ellipse_tpu.resilience.guard import (
+    HEALTH_BREAKDOWN,
+    HEALTH_CONVERGED,
+    HEALTH_NONFINITE,
+    HEALTH_STAGNATION,
+    GuardedResult,
+    RecoveryEvent,
+    guarded_solve,
+    health_name,
+)
+
+__all__ = [
+    "EXIT_DIVERGED",
+    "EXIT_OOM",
+    "EXIT_TIMEOUT",
+    "DivergedError",
+    "Fault",
+    "FaultPlan",
+    "GuardedResult",
+    "HEALTH_BREAKDOWN",
+    "HEALTH_CONVERGED",
+    "HEALTH_NONFINITE",
+    "HEALTH_STAGNATION",
+    "OutOfMemoryError",
+    "RecoveryEvent",
+    "SolveError",
+    "SolveTimeout",
+    "classify_error",
+    "corrupt_halo",
+    "force_breakdown",
+    "guarded_solve",
+    "health_name",
+    "inject_nan",
+    "inject_stagnation",
+    "is_oom_error",
+    "simulate_oom",
+    "simulated_vmem",
+    "truncate_latest_checkpoint",
+]

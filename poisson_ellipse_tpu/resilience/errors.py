@@ -1,0 +1,117 @@
+"""Classified solve failures: one exception taxonomy, one exit-code contract.
+
+The reference's failure story is a printf and a nonzero ``exit`` with no
+taxonomy (``stage0/Withoutopenmp1.cpp:128`` prints "Breakdown" and
+returns); the JAX runtime's is an opaque ``XlaRuntimeError`` whose only
+machine-readable content is a status-prefixed message string. A serving
+stack needs the middle layer: every way a guarded solve can fail maps to
+exactly one :class:`SolveError` subclass, each carrying the process exit
+code the harness CLI contracts to return:
+
+  ========  ====================  ===========================================
+  exit      class                 meaning
+  ========  ====================  ===========================================
+  2         DivergedError         recovery ladder exhausted: persistent
+                                  breakdown / NaN poisoning / stagnation
+  3         OutOfMemoryError      RESOURCE_EXHAUSTED with no engine left to
+                                  degrade to
+  4         SolveTimeout          ``--timeout`` deadline passed at a chunk
+                                  boundary (partial trace artifact emitted)
+  ========  ====================  ===========================================
+
+(exit 0 = converged, 1 = iteration cap reached without convergence — the
+pre-existing harness contract — and the argparse-conventional 2 also
+covers invalid invocations, which share "the request as stated cannot
+succeed" with divergence.)
+
+:func:`classify_error` is the single place device-runtime exceptions are
+sniffed: XLA surfaces OOM as a ``RuntimeError`` whose message carries the
+``RESOURCE_EXHAUSTED`` absl status (or "Out of memory"/"Allocation …
+exceeds" phrasings, runtime-dependent), and Mosaic compile failures on an
+over-budget kernel arrive the same way. Matching on the message is the
+honest option — there is no structured error code on this API surface —
+and it lives here exactly once so the guard, the engine chain and the
+harness cannot drift.
+"""
+
+from __future__ import annotations
+
+EXIT_DIVERGED = 2
+EXIT_OOM = 3
+EXIT_TIMEOUT = 4
+
+
+class SolveError(RuntimeError):
+    """Base of the classified solve failures.
+
+    ``classification`` is the stable machine-readable tag (``diverged`` /
+    ``oom`` / ``timeout``) used in trace events and JSON reports;
+    ``exit_code`` the contracted process exit. ``iters`` is the last
+    healthy iteration count the guard reached, so a caller can report
+    how far the solve got before it was given up on.
+    """
+
+    classification = "error"
+    exit_code = 1
+
+    def __init__(self, message: str, iters: int | None = None):
+        super().__init__(message)
+        self.iters = iters
+
+
+class DivergedError(SolveError):
+    """Recovery ladder exhausted: the solve keeps producing breakdown,
+    non-finite iterates, or no progress past ``max_recoveries``."""
+
+    classification = "diverged"
+    exit_code = EXIT_DIVERGED
+
+
+class OutOfMemoryError(SolveError):
+    """RESOURCE_EXHAUSTED at compile or run time with no smaller engine
+    left on the capacity ladder to degrade to."""
+
+    classification = "oom"
+    exit_code = EXIT_OOM
+
+
+class SolveTimeout(SolveError):
+    """The per-solve deadline passed. Raised only at chunk boundaries —
+    the in-flight chunk is allowed to complete, so the carry the guard
+    holds (and any trace events already flushed) stay consistent."""
+
+    classification = "timeout"
+    exit_code = EXIT_TIMEOUT
+
+
+# status phrasings XLA/Mosaic use for memory exhaustion, across runtime
+# versions; matched case-sensitively (they are absl status spellings)
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "exceeds the memory capacity",
+    "Attempting to allocate",
+)
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """True when ``exc`` is a device memory-exhaustion failure."""
+    if isinstance(exc, OutOfMemoryError):
+        return True
+    if isinstance(exc, MemoryError):
+        return True
+    text = str(exc)
+    return any(marker in text for marker in _OOM_MARKERS)
+
+
+def classify_error(exc: BaseException) -> str:
+    """The classification tag for an arbitrary exception out of a solve
+    dispatch: ``oom`` / ``timeout`` / ``diverged`` (already-classified
+    SolveErrors keep their own tag) or ``unknown`` for everything else —
+    unknowns must stay loud, never be swallowed into a retry loop."""
+    if isinstance(exc, SolveError):
+        return exc.classification
+    if is_oom_error(exc):
+        return "oom"
+    return "unknown"

@@ -1,0 +1,232 @@
+"""Deterministic fault injection: the recovery paths get *exercised*.
+
+A recovery ladder nobody can trigger is dead code with a comforting
+docstring. This module is the chaos harness the guarded solve
+(:mod:`.guard`) is tested — and demoed (``harness inject``) — against:
+every fault class the guard claims to survive can be injected at an
+exact, reproducible point, with no randomness and no real hardware
+failure required.
+
+Fault classes (each maps to one detection bit or error path in the
+guard):
+
+- ``nan``        — poison a named carry field (default ``r``) with NaN at
+                   iteration ``k``: the silent-f32-propagation failure.
+- ``breakdown``  — raise the carry's breakdown flag at iteration ``k``:
+                   the (Ap, p) < 1e-15 exit every engine detects but none
+                   recovered from.
+- ``stagnation`` — blow the carried ``zr`` (γ for the pipelined
+                   recurrence) up to 1e30 at iteration ``k``: the next α
+                   is garbage, the iterates jump far from the solution,
+                   and the solve makes no further progress — the drifted-
+                   recurrence failure mode of the pipelined literature.
+- ``halo``       — overwrite a halo-width slab of a carry field with NaN:
+                   the corrupted-neighbour-exchange shape of the same
+                   poisoning, meaningful on sharded carries.
+- ``oom``        — raise a ``RESOURCE_EXHAUSTED``-classified error from
+                   the solve dispatch at iteration ``k``: what a real
+                   device OOM looks like to the host.
+
+Separately, :func:`simulated_vmem` shrinks the VMEM capacity the engine
+capacity gates (``fits_resident``/``fits_streamed``) read — so
+``select_engine``'s degradation ladder can be walked deterministically —
+and :func:`truncate_latest_checkpoint` corrupts an on-disk checkpoint
+the way a mid-write kill does, for the quarantine-on-resume path in
+``solver.checkpoint``.
+
+Injection happens at guard chunk boundaries: a :class:`FaultPlan` handed
+to ``guarded_solve`` makes the guard stop a chunk exactly at each
+fault's iteration (``next_stop``) and corrupt the carry there
+(``apply``) — deterministic to the iteration, bit-reproducible, and
+entirely outside the traced loop (the injected program is the production
+program; only the carry between chunks is touched).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+
+import jax.numpy as jnp
+
+FAULT_KINDS = ("nan", "breakdown", "stagnation", "halo", "oom")
+
+
+class SimulatedResourceExhausted(RuntimeError):
+    """The injected stand-in for a device OOM. Its message carries the
+    absl ``RESOURCE_EXHAUSTED`` status marker, so it classifies exactly
+    as the real thing (``resilience.errors.classify_error``)."""
+
+
+@dataclasses.dataclass
+class Fault:
+    """One injected fault: ``kind`` at iteration ``at_iter``.
+
+    ``field`` names the carry field to corrupt (engine-adapter field
+    names: classical ``w/r/p/zr``, pipelined ``x/r/u/w/z/s/p/gamma``);
+    defaults per kind. ``rows`` is the slab height for ``halo``.
+    ``fired`` makes every fault one-shot — a replayed chunk after a
+    recovery re-runs clean, which is what makes transient-fault recovery
+    hit exact oracle parity. ``persistent=True`` re-fires on every visit
+    to the iteration instead (the unfixable-fault shape): a restart
+    cannot clear it, so the guard is forced up the ladder — precision
+    escalation, engine fallback — and finally into the classified error.
+    """
+
+    kind: str
+    at_iter: int = 0
+    field: str | None = None
+    rows: int = 1
+    fired: bool = False
+    persistent: bool = False
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind: {self.kind!r} (one of {FAULT_KINDS})"
+            )
+        if self.at_iter < 0:
+            raise ValueError("at_iter must be >= 0")
+
+
+def inject_nan(at_iter: int, field: str = "r") -> Fault:
+    """NaN-poison carry field ``field`` at iteration ``at_iter``."""
+    return Fault("nan", at_iter=at_iter, field=field)
+
+
+def force_breakdown(at_iter: int) -> Fault:
+    """Raise the breakdown flag at iteration ``at_iter``."""
+    return Fault("breakdown", at_iter=at_iter)
+
+
+def inject_stagnation(at_iter: int) -> Fault:
+    """Corrupt the carried zr/γ so the solve stops progressing."""
+    return Fault("stagnation", at_iter=at_iter)
+
+
+def corrupt_halo(at_iter: int, field: str = "r", rows: int = 1) -> Fault:
+    """NaN a ``rows``-high halo slab of ``field`` at ``at_iter``."""
+    return Fault("halo", at_iter=at_iter, field=field, rows=rows)
+
+
+def simulate_oom(at_iter: int = 0) -> Fault:
+    """Raise a RESOURCE_EXHAUSTED-classified error at ``at_iter``."""
+    return Fault("oom", at_iter=at_iter)
+
+
+class FaultPlan:
+    """An ordered set of one-shot faults the guard consults at chunk
+    boundaries. Empty plan = production behaviour (the guard's healthy
+    path does not depend on the plan's presence)."""
+
+    def __init__(self, *faults: Fault):
+        self.faults = list(faults)
+
+    def __bool__(self) -> bool:
+        return any(not f.fired for f in self.faults)
+
+    def next_stop(self, k: int) -> int | None:
+        """The earliest unfired fault iteration strictly past ``k`` —
+        the guard caps its next chunk there so injection lands on an
+        exact iteration, not somewhere inside a chunk."""
+        pending = [f.at_iter for f in self.faults if not f.fired and f.at_iter > k]
+        return min(pending) if pending else None
+
+    def apply(self, k: int, state, fields: dict[str, int], breakdown_index: int,
+              zr_index: int):
+        """Fire every unfired fault scheduled at iteration ``k`` against
+        ``state`` (an engine carry tuple); returns the corrupted carry.
+        ``oom`` faults raise :class:`SimulatedResourceExhausted` instead,
+        exactly where a real dispatch would."""
+        for fault in self.faults:
+            if fault.fired or fault.at_iter != k:
+                continue
+            if not fault.persistent:
+                fault.fired = True
+            if fault.kind == "oom":
+                raise SimulatedResourceExhausted(
+                    "RESOURCE_EXHAUSTED: simulated device OOM "
+                    f"(fault injection at iteration {k})"
+                )
+            state = _corrupt(state, fault, fields, breakdown_index, zr_index)
+        return state
+
+
+def _corrupt(state, fault: Fault, fields: dict[str, int],
+             breakdown_index: int, zr_index: int):
+    state = list(state)
+    if fault.kind == "breakdown":
+        state[breakdown_index] = jnp.asarray(True)
+    elif fault.kind == "stagnation":
+        if "s" in fields:
+            # pipelined carry: corrupt the recurrence-maintained s = A·p.
+            # The drifted recurrence then satisfies the step-norm stopping
+            # rule at a garbage iterate (α collapses, diff → 0) — the
+            # false-convergence form of stagnation the guard's residual-
+            # drift check exists for.
+            s = state[fields["s"]]
+            state[fields["s"]] = jnp.full_like(s, 1e12)
+        else:
+            # classical carry: blow the carried zr — the next α is
+            # garbage, the iterates jump far from the solution, and the
+            # solve stops progressing.
+            zr = state[zr_index]
+            state[zr_index] = jnp.asarray(1e30, zr.dtype)
+    elif fault.kind in ("nan", "halo"):
+        field = fault.field or "r"
+        if field not in fields:
+            raise ValueError(
+                f"engine carry has no field {field!r} (has {sorted(fields)})"
+            )
+        idx = fields[field]
+        arr = state[idx]
+        if fault.kind == "nan":
+            state[idx] = jnp.full_like(arr, jnp.nan)
+        else:
+            state[idx] = arr.at[: fault.rows].set(jnp.nan)
+    return tuple(state)
+
+
+@contextlib.contextmanager
+def simulated_vmem(capacity_bytes: int):
+    """Shrink the VMEM capacity every engine capacity gate sees.
+
+    Inside the context, ``fits_resident``/``fits_streamed`` (and with
+    them ``select_engine``) budget against ``capacity_bytes`` instead of
+    the device table — the deterministic stand-in for running on a part
+    too small for the picked engine."""
+    from poisson_ellipse_tpu.utils.device import vmem_capacity_override
+
+    with vmem_capacity_override(capacity_bytes):
+        yield
+
+
+def truncate_latest_checkpoint(directory: str) -> str:
+    """Truncate the largest file of the newest checkpoint step in
+    ``directory`` to half its size — the on-disk shape of a kill during
+    a checkpoint write. Returns the truncated path.
+
+    Used by the quarantine-on-resume tests of ``solver.checkpoint``: a
+    resume over this damage must fall back to the previous step, not
+    crash mid-restore.
+    """
+    steps = [
+        name for name in os.listdir(directory)
+        if name.isdigit() and os.path.isdir(os.path.join(directory, name))
+    ]
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {directory}")
+    step_dir = os.path.join(directory, max(steps, key=int))
+    largest, size = None, -1
+    for dirpath, _dirnames, filenames in os.walk(step_dir):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            n = os.path.getsize(path)
+            if n > size:
+                largest, size = path, n
+    if largest is None:
+        raise FileNotFoundError(f"no files under {step_dir}")
+    with open(largest, "r+b") as fh:
+        fh.truncate(size // 2)
+    return largest

@@ -1,0 +1,827 @@
+"""Guarded solves: failure as a handled state, not a wrong answer.
+
+Every engine in the zoo *detects* failure — the ``breakdown`` flag in the
+PCG carry stops the loop, a NaN propagates until an oracle notices — but
+none recovers. This module wraps any registered engine in a guard that
+runs the solve in chunks of K iterations (the checkpoint chunking
+machinery, which already proves chunk boundaries do not change the
+arithmetic) and, between chunks, reads a SINGLE device-side health word:
+
+  bit 0  breakdown   the carry's (Ap, p) < 1e-15 exit fired
+  bit 1  nonfinite   NaN/Inf anywhere in the carry's vectors or scalars
+  bit 2  stagnation  a full chunk ran and neither zr nor the step norm
+                     improved (the drifted-recurrence failure the
+                     pipelined literature's residual replacement exists
+                     for)
+  bit 3  converged   the loop's own stopping rule fired
+
+The zero-host-syncs-per-iteration invariant is preserved: the traced
+chunk is byte-for-byte the production ``advance`` loop (jaxpr-pinned in
+``tests/test_resilience.py`` — zero overhead when healthy), and the
+health word is one extra tiny dispatch plus one ``int()`` per chunk —
+off the per-iteration hot path by construction.
+
+On an unhealthy chunk the guard applies a recovery ladder:
+
+1. **True residual restart** — from the last trustworthy iterate
+   (breakdown keeps its own pre-update carry; NaN/stagnation roll back
+   to the previous healthy chunk boundary), rebuild the recurrence state
+   from ground truth: ``r = rhs − A·w``, fresh preconditioned residual,
+   fresh ``zr`` — KEEPING the search direction ``p``. Keeping ``p`` is
+   load-bearing: it is exactly the fixed-cadence residual replacement
+   ``ops.pipelined_pcg`` already performs (Ghysels–Vanroose §4.3), which
+   preserves the Krylov direction and with it oracle iteration parity
+   (measured: restart-with-p reconverges in the clean run's exact count;
+   a full ``p = z`` restart costs ~25% more iterations).
+2. **Precision escalation** — on the xla-stencil path with f32/bf16 and
+   ``jax_enable_x64`` on, recast the carry and operands to f64 and
+   restart there: round-off-driven breakdown and stagnation are f32
+   phenomena (the pipelined module's measured spurious-breakdown note).
+3. **Engine fallback** — pipelined → classical (the direction ``p`` and
+   iterate carry over; the classical recurrence has no drift to manage),
+   pallas → xla stencil. RESOURCE_EXHAUSTED at dispatch takes this rung
+   directly — a restart cannot fix an OOM.
+
+Every recovery emits an ``obs.trace`` ``recovery:*`` event and counts
+against ``max_recoveries``; exhaustion raises the classified
+:class:`~poisson_ellipse_tpu.resilience.errors.SolveError` (never a NaN
+result dressed up as a converged ``PCGResult``). The VMEM mega-kernel
+engines (resident/streamed/xl — scalar state lives in kernel scratch, so
+there is no carry to chunk) are guarded at whole-solve granularity: the
+result is health-checked and failures degrade down the capacity ladder
+resident → streamed → xl → guarded xla.
+
+Faults are injectable at exact iterations via
+:class:`~poisson_ellipse_tpu.resilience.faultinject.FaultPlan` — the
+recovery paths are exercised, not assumed (``harness inject``,
+``tests/test_resilience.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.reduction import grid_dot
+from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
+from poisson_ellipse_tpu.resilience.errors import (
+    DivergedError,
+    OutOfMemoryError,
+    SolveError,
+    SolveTimeout,
+    classify_error,
+)
+from poisson_ellipse_tpu.resilience.faultinject import FaultPlan
+from poisson_ellipse_tpu.solver.pcg import PCGResult
+
+HEALTH_BREAKDOWN = 1
+HEALTH_NONFINITE = 2
+HEALTH_STAGNATION = 4
+HEALTH_CONVERGED = 8
+
+_UNHEALTHY = HEALTH_BREAKDOWN | HEALTH_NONFINITE | HEALTH_STAGNATION
+
+# single-chip capacity ladder the whole-solve guard degrades down; the
+# last rung is the chunked guarded xla loop, which has no capacity gate
+_CAPACITY_LADDER = ("resident", "streamed", "xl")
+
+DEFAULT_CHUNK = 128
+
+# Convergence-claim verification: a drifted recurrence can satisfy the
+# step-norm stopping rule with a garbage iterate (measured: corrupting
+# the pipelined carry's s gives diff ~ 1e-16 at an iterate nowhere near
+# the solution — the silent wrong answer). Before the guard accepts a
+# converged chunk it checks ‖r_carried − (rhs − A·w)‖ / ‖rhs‖: healthy
+# recurrences track the true residual to accumulated round-off (≲1e-6
+# relative at convergence, f32), drifted ones miss by orders of
+# magnitude. One extra dispatch at the FINAL chunk only — the
+# per-iteration loop is untouched.
+RESIDUAL_DRIFT_TOL = 1e-2
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryEvent:
+    """One recovery action: what fired, where, and what the guard did."""
+
+    kind: str  # residual-restart / precision-escalation / engine-fallback
+    at_iter: int
+    health: int
+    engine: str
+    detail: str = ""
+
+
+class GuardedResult(NamedTuple):
+    """A guarded solve's outcome: the PCGResult plus the recovery story
+    (empty ``recoveries`` = the healthy path ran start to finish) and
+    the engine/dtype that actually finished the solve (they differ from
+    the request after an escalation or fallback)."""
+
+    result: PCGResult
+    recoveries: tuple[RecoveryEvent, ...]
+    engine: str
+    dtype: str
+
+
+def health_name(word: int) -> str:
+    """Human label for a health word's unhealthy bits."""
+    names = []
+    if word & HEALTH_BREAKDOWN:
+        names.append("breakdown")
+    if word & HEALTH_NONFINITE:
+        names.append("nonfinite")
+    if word & HEALTH_STAGNATION:
+        names.append("stagnation")
+    return "+".join(names) or "healthy"
+
+
+def _health_word(vectors, zr, diff, k, converged, breakdown, zr_prev,
+                 diff_prev, limit):
+    """The packed int32 health word — shared by every adapter. Pure
+    array ops over the carry; the guard reads ONE host int per chunk."""
+    finite = jnp.asarray(True)
+    for v in vectors:
+        finite = finite & jnp.all(jnp.isfinite(v))
+    finite = finite & jnp.isfinite(zr) & ~jnp.isnan(diff)
+    # no progress over a full chunk (neither zr nor the step norm
+    # improved), or a non-positive zr — (z, r) is an energy inner
+    # product, strictly positive for the SPD operator until convergence;
+    # zr ≤ 0 means the recurrence no longer describes the system
+    stalled = (
+        (k == limit)
+        & ~converged
+        & ~breakdown
+        & (zr >= zr_prev)
+        & (diff >= diff_prev)
+    ) | (~converged & ~breakdown & (zr <= 0))
+    return (
+        breakdown.astype(jnp.int32) * HEALTH_BREAKDOWN
+        + (~finite).astype(jnp.int32) * HEALTH_NONFINITE
+        + stalled.astype(jnp.int32) * HEALTH_STAGNATION
+        + converged.astype(jnp.int32) * HEALTH_CONVERGED
+    )
+
+
+def _cast_carry(state, dtype):
+    """Recast a carry's floating fields (precision escalation); integer
+    counters and boolean flags pass through unchanged."""
+    out = []
+    for x in state:
+        x = jnp.asarray(x)
+        out.append(x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x)
+    return tuple(out)
+
+
+# --------------------------------------------------------------------------
+# engine adapters: one duck-typed chunk/health/recover interface per carry
+# --------------------------------------------------------------------------
+
+
+class _ClassicalAdapter:
+    """The classical single-chip carry (``solver.pcg``), xla or pallas
+    stencil. Carry layout (k, w, r, p, zr, diff, converged, breakdown)."""
+
+    FIELDS = {"w": 1, "r": 2, "p": 3, "zr": 4}
+    K, ZR, DIFF, CONV, BD = 0, 4, 5, 6, 7
+
+    def __init__(self, problem: Problem, dtype, stencil: str = "xla",
+                 interpret=None, operands=None):
+        from poisson_ellipse_tpu.solver.pcg import (
+            advance as pcg_advance,
+            init_state as pcg_init_state,
+        )
+
+        self.problem = problem
+        self.dtype = dtype
+        self.stencil = stencil
+        self.interpret = interpret
+        self.engine = "xla" if stencil == "xla" else "pallas"
+        a, b, rhs = (
+            operands if operands is not None
+            else assembly.assemble(problem, dtype)
+        )
+        self._operands = (a, b, rhs)
+        self.rhs_norm = float(jnp.sqrt(jnp.sum(rhs.astype(jnp.float32) ** 2)))
+        self._init = lambda: pcg_init_state(problem, a, b, rhs)
+        # the raw chunk closure IS the production advance — exposed
+        # unjitted so tests can pin the guarded jaxpr against it
+        self.advance_fn = lambda state, limit: pcg_advance(
+            problem, a, b, rhs, state, limit=limit, stencil=stencil
+        )
+        # one compiled advance per adapter, the bound traced (no
+        # recompile per chunk); carry not donated — the guard keeps the
+        # previous healthy carry alive as the rollback point
+        self.advance = jax.jit(self.advance_fn)  # tpulint: disable=TPU006
+
+        h1 = jnp.asarray(problem.h1, dtype)
+        h2 = jnp.asarray(problem.h2, dtype)
+        d = diag_d(a, b, h1, h2)
+
+        def recover(state):
+            # true residual restart KEEPING the search direction (the
+            # residual-replacement form — see module docstring)
+            k, w, _r, p, _zr, diff, _c, _bd = state[:8]
+            r2 = rhs - apply_a(w, a, b, h1, h2)
+            z2 = apply_dinv(r2, d)
+            zr2 = grid_dot(z2, r2, h1, h2)
+            p2 = jnp.where(jnp.all(jnp.isfinite(p)), p, z2)
+            return (
+                k, w, r2, p2, zr2, diff,
+                jnp.asarray(False), jnp.asarray(False),
+            )
+
+        self.recover = jax.jit(recover)  # tpulint: disable=TPU006
+
+        def health(state, zr_prev, diff_prev, limit):
+            k, w, r, p, zr, diff, conv, bd = state[:8]
+            return _health_word(
+                (w, r, p), zr, diff, k, conv, bd, zr_prev, diff_prev, limit
+            )
+
+        # no donation: the carry doubles as the guard's rollback point
+        self.health = jax.jit(health)  # tpulint: disable=TPU004,TPU006
+
+    def init(self):
+        return self._init()
+
+    def scalars(self, state):
+        return state[self.ZR], state[self.DIFF]
+
+    def result(self, state) -> PCGResult:
+        from poisson_ellipse_tpu.solver.pcg import result_of
+
+        return result_of(state)
+
+    def escalate(self):
+        if self.stencil != "xla" or jnp.dtype(self.dtype).itemsize >= 8:
+            return None
+        if not jax.config.jax_enable_x64:
+            return None
+        adapter = _ClassicalAdapter(
+            # tpulint: disable=TPU001 — escalation is gated on x64 above
+            self.problem, jnp.float64, stencil="xla"
+        )
+        # tpulint: disable=TPU001 — escalation is refused without x64
+        return adapter, lambda state: _cast_carry(state, jnp.float64)
+
+    def fallback(self):
+        if self.stencil == "pallas":
+            adapter = _ClassicalAdapter(
+                self.problem, self.dtype, stencil="xla",
+                operands=self._operands,
+            )
+            return adapter, lambda state: state
+        return None
+
+
+class _PipelinedAdapter:
+    """The pipelined carry (``ops.pipelined_pcg``): (k, x, r, u, w, z, s,
+    p, γ₋₁, diff, converged, breakdown). Its zr-series is γ."""
+
+    FIELDS = {
+        "x": 1, "r": 2, "u": 3, "w": 4, "z": 5, "s": 6, "p": 7, "gamma": 8,
+    }
+    K, ZR, DIFF, CONV, BD = 0, 8, 9, 10, 11
+
+    def __init__(self, problem: Problem, dtype, stencil: str = "xla",
+                 interpret=None):
+        from poisson_ellipse_tpu.ops import pipelined_pcg as _pp
+
+        self.problem = problem
+        self.dtype = dtype
+        self.stencil = stencil
+        self.interpret = interpret
+        self.engine = "pipelined" if stencil == "xla" else "pipelined-pallas"
+        a, b, rhs = assembly.assemble(problem, dtype)
+        self._operands = (a, b, rhs)
+        self.rhs_norm = float(jnp.sqrt(jnp.sum(rhs.astype(jnp.float32) ** 2)))
+        self._init = lambda: _pp.init_state(
+            problem, a, b, rhs, stencil=stencil, interpret=interpret
+        )
+        self.advance_fn = lambda state, limit: _pp.advance(
+            problem, a, b, rhs, state, limit=limit, stencil=stencil,
+            interpret=interpret,
+        )
+        self.advance = jax.jit(self.advance_fn)  # tpulint: disable=TPU006
+
+        h1 = jnp.asarray(problem.h1, dtype)
+        h2 = jnp.asarray(problem.h2, dtype)
+        d = diag_d(a, b, h1, h2)
+
+        def recover(state):
+            # the in-loop residual replacement's rebuild, applied on
+            # demand: every recurrence-maintained vector from ground
+            # truth, direction p kept (ops.pipelined_pcg.replace)
+            k, x, _r, _u, _w, _z, _s, p, g, diff, _c, _bd = state[:12]
+            r2 = rhs - apply_a(x, a, b, h1, h2)
+            u2 = apply_dinv(r2, d)
+            w2 = apply_a(u2, a, b, h1, h2)
+            s2 = apply_a(p, a, b, h1, h2)
+            z2 = apply_a(apply_dinv(s2, d), a, b, h1, h2)
+            g2 = jnp.where(jnp.isfinite(g), g, jnp.asarray(1.0, g.dtype))
+            return (
+                k, x, r2, u2, w2, z2, s2, p, g2, diff,
+                jnp.asarray(False), jnp.asarray(False),
+            )
+
+        self.recover = jax.jit(recover)  # tpulint: disable=TPU006
+
+        def health(state, zr_prev, diff_prev, limit):
+            k = state[0]
+            vectors = state[1:8]
+            g, diff, conv, bd = state[8], state[9], state[10], state[11]
+            return _health_word(
+                vectors, g, diff, k, conv, bd, zr_prev, diff_prev, limit
+            )
+
+        # no donation: the carry doubles as the guard's rollback point
+        self.health = jax.jit(health)  # tpulint: disable=TPU004,TPU006
+
+        def to_classical(state):
+            # The classical carry holds the direction for the NEXT
+            # iteration (p_out = z + βp, built end-of-body); the
+            # pipelined carry holds the direction its last iteration
+            # USED (x⁺ = x + αp⁺ with p⁺ built in-body). Handing the
+            # stale direction to the classical α = zr/(Ap,p) breaks the
+            # (r, p) = (z, r) invariant and diverges (measured) — so the
+            # conversion applies the classical end-of-iteration direction
+            # update once: p₀ = z + (zr/γ)·p.
+            k, x = state[0], state[1]
+            p, g, diff = state[7], state[8], state[9]
+            r2 = rhs - apply_a(x, a, b, h1, h2)
+            z2 = apply_dinv(r2, d)
+            zr2 = grid_dot(z2, r2, h1, h2)
+            p2 = z2 + (zr2 / g) * p
+            return (
+                k, x, r2, p2, zr2, diff,
+                jnp.asarray(False), jnp.asarray(False),
+            )
+
+        self._to_classical = jax.jit(to_classical)  # tpulint: disable=TPU006
+
+    def init(self):
+        return self._init()
+
+    def scalars(self, state):
+        return state[self.ZR], state[self.DIFF]
+
+    def result(self, state) -> PCGResult:
+        from poisson_ellipse_tpu.ops.pipelined_pcg import result_of
+
+        return result_of(state)
+
+    def escalate(self):
+        if self.stencil != "xla" or jnp.dtype(self.dtype).itemsize >= 8:
+            return None
+        if not jax.config.jax_enable_x64:
+            return None
+        adapter = _PipelinedAdapter(
+            # tpulint: disable=TPU001 — escalation is gated on x64 above
+            self.problem, jnp.float64, stencil="xla"
+        )
+        # tpulint: disable=TPU001 — escalation is refused without x64
+        return adapter, lambda state: _cast_carry(state, jnp.float64)
+
+    def fallback(self):
+        # pipelined -> classical: the iterate and the (phase-corrected)
+        # search direction carry over — see to_classical above. The
+        # operands are shared: both recurrences consume the same
+        # rounded-once (a, b, rhs), so no reassembly on the fault path.
+        adapter = _ClassicalAdapter(
+            self.problem, self.dtype, stencil="xla",
+            operands=self._operands,
+        )
+        return adapter, self._to_classical
+
+
+class _ShardedAdapter:
+    """The mesh-sharded classical carry (``parallel.pcg_sharded``'s
+    stepper): same layout as the single-chip classical carry, w/r/p
+    global padded arrays sharded P('x','y'), scalars replicated."""
+
+    FIELDS = {"w": 1, "r": 2, "p": 3, "zr": 4}
+    K, ZR, DIFF, CONV, BD = 0, 4, 5, 6, 7
+
+    def __init__(self, problem: Problem, mesh, dtype, stencil: str = "xla"):
+        from poisson_ellipse_tpu.parallel.pcg_sharded import (
+            build_sharded_recover,
+            build_sharded_stepper,
+        )
+
+        self.problem = problem
+        self.mesh = mesh
+        self.dtype = dtype
+        self.stencil = stencil
+        self.engine = stencil
+        self._init, self.advance = build_sharded_stepper(
+            problem, mesh, dtype, stencil_impl=stencil
+        )
+        self.advance_fn = self.advance  # already jit-wrapped by the stepper
+        self.recover = build_sharded_recover(
+            problem, mesh, dtype, stencil_impl=stencil
+        )
+        import numpy as np
+
+        self.rhs_norm = float(
+            np.linalg.norm(assembly.assemble_numpy(problem)[2])
+        )
+
+        def health(state, zr_prev, diff_prev, limit):
+            k, w, r, p, zr, diff, conv, bd = state[:8]
+            return _health_word(
+                (w, r, p), zr, diff, k, conv, bd, zr_prev, diff_prev, limit
+            )
+
+        # no donation: the carry doubles as the guard's rollback point
+        self.health = jax.jit(health)  # tpulint: disable=TPU004,TPU006
+
+    def init(self):
+        return self._init()
+
+    def scalars(self, state):
+        return state[self.ZR], state[self.DIFF]
+
+    def result(self, state) -> PCGResult:
+        from poisson_ellipse_tpu.parallel.pcg_sharded import sharded_result_of
+
+        return sharded_result_of(self.problem, state)
+
+    def escalate(self):
+        if self.stencil != "xla" or jnp.dtype(self.dtype).itemsize >= 8:
+            return None
+        if not jax.config.jax_enable_x64:
+            return None
+        adapter = _ShardedAdapter(
+            # tpulint: disable=TPU001 — escalation is gated on x64 above
+            self.problem, self.mesh, jnp.float64, stencil="xla"
+        )
+        # tpulint: disable=TPU001 — escalation is refused without x64
+        return adapter, lambda state: _cast_carry(state, jnp.float64)
+
+    def fallback(self):
+        if self.stencil == "pallas":
+            adapter = _ShardedAdapter(
+                self.problem, self.mesh, self.dtype, stencil="xla"
+            )
+            return adapter, lambda state: state
+        return None
+
+
+def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret):
+    if mesh is not None:
+        if engine in ("auto", "xla"):
+            return _ShardedAdapter(problem, mesh, dtype, stencil="xla")
+        if engine == "pallas":
+            return _ShardedAdapter(problem, mesh, dtype, stencil="pallas")
+        raise ValueError(
+            f"guarded sharded solves run the chunked classical stepper "
+            f"('xla'/'pallas'); got engine={engine!r} — the fused/"
+            "pipelined sharded iterations have no resumable stepper form"
+        )
+    if engine == "xla":
+        return _ClassicalAdapter(problem, dtype, stencil="xla")
+    if engine == "pallas":
+        return _ClassicalAdapter(
+            problem, dtype, stencil="pallas", interpret=interpret
+        )
+    if engine == "pipelined":
+        return _PipelinedAdapter(
+            problem, dtype, stencil="xla", interpret=interpret
+        )
+    if engine == "pipelined-pallas":
+        return _PipelinedAdapter(
+            problem, dtype, stencil="pallas", interpret=interpret
+        )
+    raise ValueError(f"no chunked adapter for engine {engine!r}")
+
+
+# --------------------------------------------------------------------------
+# the guard driver
+# --------------------------------------------------------------------------
+
+
+def guarded_solve(
+    problem: Problem,
+    engine: str = "xla",
+    dtype=jnp.float32,
+    *,
+    mesh=None,
+    chunk: int = DEFAULT_CHUNK,
+    max_recoveries: int = 3,
+    timeout: Optional[float] = None,
+    faults: Optional[FaultPlan] = None,
+    interpret=None,
+) -> GuardedResult:
+    """Solve with failure detection and the recovery ladder (module
+    docstring). Loop engines (xla / pallas / pipelined / pipelined-pallas,
+    and the sharded classical stepper via ``mesh=``) run chunked with a
+    per-chunk health word; the VMEM mega-kernel engines (resident /
+    streamed / xl / fused, and ``auto``) run whole-solve with the
+    capacity-ladder fallback.
+
+    ``timeout`` (seconds) is enforced at chunk boundaries — the cancel
+    is graceful: the in-flight chunk completes, then
+    :class:`SolveTimeout` carries the last healthy iteration count out.
+    ``faults`` is the deterministic injection plan (tests, ``harness
+    inject``); production callers pass none.
+
+    Raises the classified :class:`SolveError` subclasses on recovery
+    exhaustion (``DivergedError``), memory exhaustion with no engine
+    left (``OutOfMemoryError``), or deadline (``SolveTimeout``). A
+    non-finite carry is never returned as a converged result.
+    """
+    if chunk < 1:
+        raise ValueError("chunk must be >= 1")
+    t0 = time.monotonic()
+    plan = faults if faults is not None else FaultPlan()
+    events: list[RecoveryEvent] = []
+
+    if mesh is None and engine in ("auto", "resident", "streamed", "xl",
+                                   "fused"):
+        return _guarded_whole_solve(
+            problem, engine, dtype, interpret=interpret, chunk=chunk,
+            max_recoveries=max_recoveries, timeout=timeout, t0=t0,
+            plan=plan, events=events,
+        )
+
+    adapter = _make_adapter(problem, engine, dtype, mesh, interpret)
+    return _run_chunked(
+        problem, adapter, chunk=chunk, max_recoveries=max_recoveries,
+        timeout=timeout, t0=t0, plan=plan, events=events,
+    )
+
+
+def _record(events: list[RecoveryEvent], kind: str, at_iter: int, health: int,
+            engine: str, detail: str = "") -> None:
+    events.append(RecoveryEvent(kind, at_iter, health, engine, detail))
+    obs_trace.event(
+        f"recovery:{kind}",
+        iter=at_iter,
+        health=health_name(health) if health else "error",
+        engine=engine,
+        detail=detail,
+    )
+
+
+def _residual_drift(adapter, state) -> float:
+    """Relative drift of the carried residual vs ground truth — the
+    convergence-claim check. Reuses the adapter's recover dispatch (its
+    rebuilt r IS the true residual); one extra dispatch, final chunk
+    only."""
+    rebuilt = adapter.recover(state)
+    idx = adapter.FIELDS["r"]
+    num = jnp.sqrt(jnp.sum((state[idx] - rebuilt[idx]).astype(jnp.float32) ** 2))
+    return float(num) / max(adapter.rhs_norm, 1e-30)
+
+
+def _check_deadline(timeout, t0, k: int) -> None:
+    if timeout is not None and time.monotonic() - t0 > timeout:
+        obs_trace.event("recovery:timeout", iter=k, timeout_s=timeout)
+        raise SolveTimeout(
+            f"solve exceeded --timeout {timeout:g}s at iteration {k} "
+            "(chunk-boundary cancel; partial trace flushed)",
+            iters=k,
+        )
+
+
+def _run_chunked(problem, adapter, *, chunk, max_recoveries, timeout, t0,
+                 plan, events) -> GuardedResult:
+    state = adapter.init()
+    prev = state  # last healthy chunk-boundary carry: the rollback point
+    k = 0
+    nrec = 0
+    consecutive = 0
+    stag_strikes = 0
+    max_iter = problem.max_iterations
+
+    while True:
+        _check_deadline(timeout, t0, k)
+        stop = plan.next_stop(k - 1)  # a fault AT k fires before this chunk
+        limit = min(k + chunk, max_iter)
+        if stop is not None and k < stop:
+            limit = min(limit, stop)
+        try:
+            run_state = plan.apply(
+                k, state, adapter.FIELDS, adapter.BD, adapter.ZR
+            ) if plan else state
+            new = adapter.advance(run_state, limit)
+            word = int(adapter.health(new, *adapter.scalars(state), limit))
+        except SolveError:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below, re-raised
+            if classify_error(e) != "oom":
+                raise  # unknown failures stay loud, never retried
+            nrec += 1
+            if nrec > max_recoveries:
+                raise OutOfMemoryError(
+                    f"OOM after {max_recoveries} recoveries: {e}", iters=k
+                ) from e
+            fb = adapter.fallback()
+            if fb is None:
+                raise OutOfMemoryError(
+                    f"{adapter.engine} hit RESOURCE_EXHAUSTED with no "
+                    f"smaller engine to degrade to: {e}",
+                    iters=k,
+                ) from e
+            adapter2, convert = fb
+            _record(
+                events, "engine-fallback", k, 0, adapter2.engine,
+                detail=f"oom on {adapter.engine}: {e}",
+            )
+            state = prev = adapter2.recover(convert(prev))
+            adapter = adapter2
+            consecutive = 1
+            stag_strikes = 0
+            continue
+
+        if word & HEALTH_CONVERGED and not word & _UNHEALTHY:
+            drift = _residual_drift(adapter, new)
+            if drift <= RESIDUAL_DRIFT_TOL:
+                state = new
+                break
+            # the stopping rule fired on a drifted recurrence: the
+            # iterate is NOT a solution — a silent wrong answer without
+            # this check. Treat as stagnation and recover now (a false
+            # convergence cannot resolve itself: the loop just exits
+            # again, so the stagnation debounce below is pointless here).
+            word = (word & ~HEALTH_CONVERGED) | HEALTH_STAGNATION
+            stag_strikes = 1
+        if not word & _UNHEALTHY:
+            state = prev = new
+            k = limit
+            consecutive = 0
+            stag_strikes = 0
+            if k >= max_iter:
+                break
+            continue
+
+        if (
+            (word & _UNHEALTHY) == HEALTH_STAGNATION
+            and stag_strikes == 0
+            and limit < max_iter
+        ):
+            # Debounce pure stagnation one chunk: a recovery or engine
+            # transition legitimately bumps zr/diff for a few iterations
+            # while CG re-adapts its direction (measured on the
+            # pipelined->classical fallback). prev stays PINNED at the
+            # last trusted boundary — if the stall is real, the next
+            # strike rolls back to it, so nothing is lost but one chunk
+            # of wall clock. Breakdown/NaN stay immediate.
+            stag_strikes = 1
+            state = new
+            k = limit
+            continue
+
+        # ---- unhealthy chunk: walk the recovery ladder -------------------
+        nrec += 1
+        if nrec > max_recoveries:
+            raise DivergedError(
+                f"recovery budget exhausted ({max_recoveries}): solve is "
+                f"{health_name(word)} at iteration ~{k}",
+                iters=k,
+            )
+        # breakdown discards its own update, so the carry it stops with
+        # is trustworthy; NaN/stagnation poison the chunk — roll back
+        base = new if (word & _UNHEALTHY) == HEALTH_BREAKDOWN else prev
+        k = int(base[adapter.K])
+        stag_strikes = 0
+
+        if consecutive == 0:
+            _record(events, "residual-restart", k, word, adapter.engine)
+            state = prev = adapter.recover(base)
+            consecutive = 1
+            continue
+        esc = adapter.escalate()
+        if esc is not None:
+            adapter2, convert = esc
+            _record(
+                events, "precision-escalation", k, word, adapter2.engine,
+                detail=f"{jnp.dtype(adapter.dtype).name} -> "
+                f"{jnp.dtype(adapter2.dtype).name}",
+            )
+            state = prev = adapter2.recover(convert(base))
+            adapter = adapter2
+            consecutive = 1
+            continue
+        fb = adapter.fallback()
+        if fb is not None:
+            adapter2, convert = fb
+            _record(
+                events, "engine-fallback", k, word, adapter2.engine,
+                detail=f"from {adapter.engine}",
+            )
+            state = prev = adapter2.recover(convert(base))
+            adapter = adapter2
+            consecutive = 1
+            continue
+        raise DivergedError(
+            f"recovery ladder exhausted: {adapter.engine} solve still "
+            f"{health_name(word)} at iteration ~{k} after restart",
+            iters=k,
+        )
+
+    result = adapter.result(state)
+    return GuardedResult(
+        result=result,
+        recoveries=tuple(events),
+        engine=adapter.engine,
+        dtype=jnp.dtype(adapter.dtype).name,
+    )
+
+
+def _fire_whole_solve_oom(plan: FaultPlan) -> None:
+    """Whole-solve engines have no iteration boundaries: any pending
+    ``oom`` fault fires at the next engine attempt."""
+    from poisson_ellipse_tpu.resilience.faultinject import (
+        SimulatedResourceExhausted,
+    )
+
+    for fault in plan.faults:
+        if not fault.fired and fault.kind == "oom":
+            fault.fired = True
+            raise SimulatedResourceExhausted(
+                "RESOURCE_EXHAUSTED: simulated device OOM (fault "
+                "injection, whole-solve attempt)"
+            )
+
+
+def _guarded_whole_solve(problem, engine, dtype, *, interpret, chunk,
+                         max_recoveries, timeout, t0, plan,
+                         events) -> GuardedResult:
+    """Guard for the VMEM mega-kernel engines: health-check the whole
+    solve's result, degrade down the capacity ladder on OOM or an
+    unhealthy result, and finish on the chunked guarded xla loop (which
+    has no capacity gate and full ladder recovery)."""
+    from poisson_ellipse_tpu.solver.engine import build_solver, select_engine
+
+    if any(not f.fired and f.kind != "oom" for f in plan.faults):
+        raise ValueError(
+            "carry-field faults need a chunked engine (xla/pallas/"
+            f"pipelined/pipelined-pallas); {engine!r} runs whole-solve "
+            "and only supports 'oom' injection"
+        )
+    resolved = select_engine(problem, dtype) if engine == "auto" else engine
+    if resolved in _CAPACITY_LADDER:
+        chain = _CAPACITY_LADDER[_CAPACITY_LADDER.index(resolved):]
+    else:
+        chain = (resolved,)
+
+    nrec = 0
+    for cand in chain:
+        _check_deadline(timeout, t0, 0)
+        try:
+            _fire_whole_solve_oom(plan)
+            solver, args, _ = build_solver(problem, cand, dtype, interpret)
+            result = solver(*args)
+            healthy = (
+                bool(jnp.all(jnp.isfinite(result.w)))
+                and not bool(result.breakdown)
+            )
+            if healthy:
+                return GuardedResult(
+                    result=result,
+                    recoveries=tuple(events),
+                    engine=cand,
+                    dtype=jnp.dtype(dtype).name,
+                )
+            word = (
+                HEALTH_BREAKDOWN if bool(result.breakdown)
+                else HEALTH_NONFINITE
+            )
+            detail = f"unhealthy whole-solve result from {cand}"
+        except SolveError:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below, re-raised
+            if classify_error(e) != "oom":
+                raise  # unknown failures stay loud
+            word, detail = 0, f"oom on {cand}: {e}"
+        nrec += 1
+        if nrec > max_recoveries:
+            raise OutOfMemoryError(
+                f"recovery budget exhausted ({max_recoveries}) degrading "
+                f"the capacity ladder at {cand}",
+                iters=0,
+            )
+        # the event's engine field names the engine fallen back TO (the
+        # chunked path's convention); the failed one rides in detail
+        idx = chain.index(cand)
+        target = chain[idx + 1] if idx + 1 < len(chain) else "xla"
+        _record(events, "engine-fallback", 0, word, target, detail=detail)
+
+    # the ladder's floor: the chunked guarded xla loop
+    remaining_timeout = (
+        None if timeout is None else max(timeout - (time.monotonic() - t0), 0.1)
+    )
+    adapter = _ClassicalAdapter(problem, dtype, stencil="xla")
+    return _run_chunked(
+        problem, adapter, chunk=chunk,
+        max_recoveries=max(max_recoveries - nrec, 0),
+        timeout=remaining_timeout, t0=time.monotonic(), plan=plan,
+        events=events,
+    )

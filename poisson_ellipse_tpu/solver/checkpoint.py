@@ -14,6 +14,24 @@ A checkpoint records a fingerprint of the Problem + dtype; resuming onto
 a different discretisation is refused rather than silently producing a
 mixed-state solve.
 
+Durability is layered (the resilience contract):
+
+- orbax's own commit protocol makes each *step* atomic — a step is
+  written under a temporary name and renamed into place only when
+  complete, so a kill mid-save never yields a half-step that
+  ``latest_step`` would pick up.
+- On top of that, every finalized step gets an ``integrity.json``
+  manifest (relative path → byte size), itself written
+  temp-then-rename, covering the window orbax's commit cannot: silent
+  corruption *after* commit (truncation by a dying filesystem, disk
+  damage). ``resume=True`` verifies the newest step against its
+  manifest before touching orbax; a corrupt/truncated step — or one
+  whose orbax restore throws — is **quarantined** (renamed to
+  ``quarantined-<step>`` with an ``obs.trace``
+  ``recovery:checkpoint-quarantine`` event) and the previous step is
+  used, instead of crashing mid-restore. Only when no step survives
+  does the run restart from iteration 0.
+
 Sharded solves checkpoint the same way: pass ``mesh=`` and the persisted
 carry is the mesh-sharded global state (w/r/p laid out ``P('x','y')``,
 scalars replicated) from ``parallel.pcg_sharded.build_sharded_stepper``.
@@ -25,7 +43,9 @@ to need checkpointing are exactly the big sharded ones.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
+import warnings
 from typing import Optional
 
 import jax
@@ -33,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import trace as obs_trace
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.solver.pcg import (
     PCGResult,
@@ -42,6 +63,27 @@ from poisson_ellipse_tpu.solver.pcg import (
 )
 
 STATE_KEYS = ("k", "w", "r", "p", "zr", "diff", "converged", "breakdown")
+
+# per-step integrity manifest (relative path -> byte size), written
+# temp-then-rename once the step is finalized on disk
+MANIFEST_NAME = "integrity.json"
+
+
+class CheckpointMismatchError(ValueError):
+    """Resume refused: the checkpoint was written by a different
+    problem/dtype/stencil/mesh. Deliberate refusal, not corruption —
+    never quarantined."""
+
+
+def _write_json_atomic(path: str, payload: dict) -> None:
+    """Write-temp-then-rename: a kill mid-write leaves the old file (or
+    nothing), never a torn one — os.replace is atomic on POSIX."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
 
 
 def _fingerprint(problem: Problem, dtype, stencil: str, mesh_shape) -> dict:
@@ -152,6 +194,113 @@ class CheckpointingSolver:
                 meta=ocp.args.JsonSave(self._fp),
             ),
         )
+        # manifests for any PREVIOUS step that has finalized by now —
+        # this piggybacks on the save cadence, so the async pipeline is
+        # never stalled just to fingerprint files
+        self._flush_manifests()
+
+    # -- integrity / quarantine ---------------------------------------------
+
+    def _step_dirs(self) -> list[int]:
+        """Finalized step directories on disk, by number. Listed from
+        the filesystem (not the manager's cached view) so quarantined
+        steps drop out immediately."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        return sorted(
+            int(n) for n in names
+            if n.isdigit() and os.path.isdir(os.path.join(self.directory, n))
+        )
+
+    def _flush_manifests(self) -> None:
+        for step in self._step_dirs():
+            step_dir = os.path.join(self.directory, str(step))
+            path = os.path.join(step_dir, MANIFEST_NAME)
+            if os.path.exists(path):
+                continue
+            manifest = {}
+            complete = True
+            for dirpath, _dirnames, filenames in os.walk(step_dir):
+                for name in filenames:
+                    if name == MANIFEST_NAME or name.endswith(".tmp"):
+                        continue
+                    full = os.path.join(dirpath, name)
+                    try:
+                        manifest[os.path.relpath(full, step_dir)] = (
+                            os.path.getsize(full)
+                        )
+                    except OSError:
+                        complete = False  # still being written: next time
+            if complete and manifest:
+                _write_json_atomic(path, manifest)
+
+    def _verify_step(self, step: int) -> Optional[str]:
+        """None when the step's files match its manifest; else the
+        defect. Steps without a manifest (pre-manifest checkpoints, or a
+        kill before the next save cadence) pass here — the orbax restore
+        attempt is their integrity check."""
+        step_dir = os.path.join(self.directory, str(step))
+        path = os.path.join(step_dir, MANIFEST_NAME)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            return f"unreadable integrity manifest: {e}"
+        for rel, size in manifest.items():
+            full = os.path.join(step_dir, rel)
+            if not os.path.exists(full):
+                return f"missing file {rel}"
+            actual = os.path.getsize(full)
+            if actual != size:
+                return f"{rel} is {actual} bytes, manifest says {size}"
+        return None
+
+    def _quarantine(self, step: int, reason: str) -> str:
+        """Move a damaged step out of the step namespace (never delete —
+        the bytes may still matter for a post-mortem) and trace it."""
+        src = os.path.join(self.directory, str(step))
+        dst = os.path.join(self.directory, f"quarantined-{step}")
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(self.directory, f"quarantined-{step}.{n}")
+        os.rename(src, dst)
+        warnings.warn(
+            f"checkpoint step {step} is corrupt ({reason}); quarantined to "
+            f"{dst} — resuming from the previous step",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        obs_trace.event(
+            "recovery:checkpoint-quarantine",
+            step=step,
+            reason=reason,
+            moved_to=os.path.basename(dst),
+        )
+        return dst
+
+    def _restore_latest_valid(self):
+        """The newest step that verifies AND restores; damaged steps are
+        quarantined and the next-older one is tried. None when no step
+        survives (the caller starts from iteration 0)."""
+        while True:
+            steps = self._step_dirs()
+            if not steps:
+                return None
+            step = steps[-1]
+            reason = self._verify_step(step)
+            if reason is None:
+                try:
+                    return self._restore(step)
+                except CheckpointMismatchError:
+                    raise  # deliberate refusal, not damage
+                except Exception as e:  # tpulint: disable=TPU009 — recovery: quarantine + retry the older step
+                    reason = f"restore failed: {type(e).__name__}: {e}"
+            self._quarantine(step, reason)
 
     def _restore(self, step: int):
         import orbax.checkpoint as ocp
@@ -163,7 +312,7 @@ class CheckpointingSolver:
             step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
         )["meta"]
         if meta != self._fp:
-            raise ValueError(
+            raise CheckpointMismatchError(
                 "checkpoint was written by a different problem/dtype: "
                 f"saved {meta}, current {self._fp}"
             )
@@ -185,14 +334,14 @@ class CheckpointingSolver:
     def run(self, resume: bool = True) -> PCGResult:
         """Solve to convergence, saving every ``chunk`` iterations.
 
-        resume=True picks up from the newest valid checkpoint in
-        ``directory`` (a restart after a kill continues mid-solve);
+        resume=True picks up from the newest VALID checkpoint in
+        ``directory`` (a restart after a kill continues mid-solve) —
+        corrupt/truncated steps are quarantined and older ones tried,
+        so damage costs at most the iterations since the last good save;
         resume=False starts from iteration 0 regardless.
         """
-        step = self.latest_step() if resume else None
-        if step is not None:
-            state = self._restore(step)
-        else:
+        state = self._restore_latest_valid() if resume else None
+        if state is None:
             state = self._init()
 
         max_iter = self.problem.max_iterations
@@ -218,6 +367,8 @@ class CheckpointingSolver:
 
     def close(self) -> None:
         self._manager.wait_until_finished()
+        # the final step's manifest: every save has landed by now
+        self._flush_manifests()
         self._manager.close()
 
     def __enter__(self):

@@ -147,7 +147,7 @@ def build_solver(
                     # recompile), so the probe costs nothing extra.
                     solver.lower(*args).compile()
                 return solver, args, cand
-            except Exception as e:  # noqa: BLE001 — fall down the chain
+            except Exception as e:  # tpulint: disable=TPU009 — chain: warn, degrade, re-raise at exhaustion
                 last_err = e
                 if cand != chain[-1]:
                     import warnings

@@ -14,6 +14,8 @@ part as fallback is the honest source.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 
 _MIB = 1024 * 1024
@@ -37,9 +39,32 @@ _VMEM_CAPACITY = {
 _MEASURED_CAPACITY = 128 * _MIB
 
 
+# Fault-injection hook (resilience.faultinject.simulated_vmem): when set,
+# every device reports this capacity, so the engine capacity gates
+# (fits_resident / fits_streamed) and select_engine can be driven through
+# their degradation paths deterministically, with no real OOM required.
+_CAPACITY_OVERRIDE: int | None = None
+
+
+@contextlib.contextmanager
+def vmem_capacity_override(capacity_bytes: int):
+    """Pretend every device ships ``capacity_bytes`` of VMEM while the
+    context is active. Test/chaos harness hook — the production tables
+    above stay the only real source."""
+    global _CAPACITY_OVERRIDE
+    prev = _CAPACITY_OVERRIDE
+    _CAPACITY_OVERRIDE = int(capacity_bytes)
+    try:
+        yield
+    finally:
+        _CAPACITY_OVERRIDE = prev
+
+
 def vmem_capacity_bytes(device=None) -> int:
     """VMEM capacity of ``device`` (default: the first default-backend
     device), from the published table; measured-part fallback."""
+    if _CAPACITY_OVERRIDE is not None:
+        return _CAPACITY_OVERRIDE
     if device is None:
         devices = jax.devices()
         device = devices[0] if devices else None

@@ -27,8 +27,8 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_eight_rules():
-    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 9)]
+def test_registry_has_all_nine_rules():
+    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)]
     for code, rule in RULES.items():
         assert rule.code == code
         assert rule.name and rule.summary
@@ -703,6 +703,108 @@ def test_tpu008_pyproject_sync_fns_loaded():
 
 
 # -- plumbing: suppression scope, CLI, report -------------------------------
+
+
+# -- TPU009: swallowed broad exceptions -------------------------------------
+
+
+def test_tpu009_positive_bare_and_broad_swallows():
+    src = """
+        def run(solver, args):
+            try:
+                return solver(*args)
+            except:
+                pass
+
+        def run2(solver, args):
+            try:
+                return solver(*args)
+            except Exception:
+                return None
+
+        def run3(solver, args):
+            try:
+                return solver(*args)
+            except (ValueError, BaseException) as e:
+                log(e)
+    """
+    assert codes_of(src) == ["TPU009", "TPU009", "TPU009"]
+
+
+def test_tpu009_negative_narrow_reraise_and_classified():
+    # a deliberately narrow class, a handler that re-raises (bare or a
+    # classified SolveError), and an else-path all stay silent
+    src = """
+        def run(solver, args):
+            try:
+                return solver(*args)
+            except ValueError:
+                return None
+
+        def run2(solver, args):
+            try:
+                return solver(*args)
+            except Exception as e:
+                if transient(e):
+                    retry()
+                raise
+
+        def run3(solver, args):
+            try:
+                return solver(*args)
+            except Exception as e:
+                raise SolveError(str(e)) from e
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu009_positive_raise_only_defined_in_nested_scope():
+    # a raise inside a nested def/lambda is never executed BY the
+    # handler — the broad except still swallows
+    src = """
+        def run(fn):
+            try:
+                return fn()
+            except Exception:
+                def retry_later():
+                    raise
+                return None
+    """
+    assert codes_of(src) == ["TPU009"]
+
+
+def test_tpu009_reraise_fns_config_knob():
+    src = """
+        from mypkg.resilience.errors import raise_classified
+
+        def run(solver, args):
+            try:
+                return solver(*args)
+            except Exception as e:
+                raise_classified(e)
+    """
+    assert codes_of(src) == ["TPU009"]
+    assert codes_of(src, reraise_fns=("*.errors.raise_classified",)) == []
+
+
+def test_tpu009_suppression_with_note():
+    src = """
+        def accounting(fn):
+            try:
+                return fn()
+            except Exception:  # tpulint: disable=TPU009 — best-effort
+                return None
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu009_pyproject_reraise_fns_loaded():
+    from poisson_ellipse_tpu.lint import load_config
+
+    # the key parses from [tool.tpulint] (empty today — the repo's own
+    # recovery paths carry literal raises)
+    config = load_config()
+    assert isinstance(config.reraise_fns, tuple)
 
 
 def test_suppression_is_per_code_not_blanket():

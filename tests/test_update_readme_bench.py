@@ -118,6 +118,39 @@ def test_observability_fields_absent_is_supported(workspace):
     assert "psum/iteration" not in readme.read_text()
 
 
+def test_recovery_field_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        recovery={
+            "grid": [100, 200], "engine": "xla", "fault": "nan", "at": 21,
+            "iters": 42, "clean_iters": 42, "converged": True,
+            "recoveries": ["residual-restart"],
+        }
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Resilience drill" in text
+    assert "iteration 21" in text
+    assert "residual-restart" in text
+    assert "reconverges in 42 iterations" in text
+    assert "oracle parity after recovery" in text
+
+
+def test_recovery_field_absent_or_failed_is_supported(workspace):
+    # pre-resilience artifacts lack the key entirely; an aborted drill
+    # carries converged: false — neither renders the line
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    assert "Resilience drill" not in readme.read_text()
+    artifact.write_text(json.dumps(make_artifact(
+        recovery={"grid": [100, 200], "engine": "xla", "fault": "nan",
+                  "at": 21, "converged": False, "aborted": "diverged"}
+    )))
+    urb.regenerate(str(readme), str(artifact))
+    assert "Resilience drill" not in readme.read_text()
+
+
 README_STUB = """# stub
 
 <!-- bench:headline -->
