@@ -40,6 +40,11 @@ def main(argv=None) -> int:
     ap.add_argument("--repeat", type=int, default=1)
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument(
+        "--lanes-per-device", type=int, default=2,
+        help="lane count per device for the batched throughput series "
+        "(lanes sharded over the mesh; 0 disables the series)",
+    )
+    ap.add_argument(
         "--real",
         action="store_true",
         help="run on the real device mesh (a pod slice). Default: a "
@@ -130,6 +135,30 @@ def main(argv=None) -> int:
             )
             iters_ok = all(abs(i - anchor) <= 2 for i in iters)
         if not iters_ok or not all(r["converged"] for r in table["rows"]):
+            rc = 1
+    if args.lanes_per_device > 0:
+        from poisson_ellipse_tpu.harness.bench_multichip import (
+            throughput_table,
+        )
+
+        # the serving scale-out series: the SAME grid, lanes sharded
+        # over a growing mesh (parallel.batched_sharded) — aggregate
+        # solves/sec should track the device count at exactly 1
+        # psum/iteration (carried in collectives_per_iter)
+        table = throughput_table(
+            grids["strong"],
+            meshes,
+            lanes_per_device=args.lanes_per_device,
+            dtype=args.dtype,
+            pipelined=args.engine == "pipelined",
+            repeat=args.repeat,
+        )
+        trace_event("multichip_table", **table)
+        print(json.dumps(table))
+        coll = table["collectives_per_iter"]
+        if not all(r["converged"] for r in table["rows"]) or (
+            coll is not None and coll["psum"] != 1
+        ):
             rc = 1
     return rc
 

@@ -1,0 +1,34 @@
+"""Batched multi-solve engines: one dispatch, B independent problems.
+
+The throughput layer of the zoo (ISSUE 5): ``batched_pcg`` /
+``batched_pipelined`` run B lanes — stacked RHS, per-lane ε/geometry
+allowed — inside one fused ``lax.while_loop`` with per-lane masked
+updates and in-loop NaN-lane quarantine; ``driver.solve_batched`` is the
+chunked form that reports quarantines as ``recovery:lane-quarantine``
+trace events and hosts fault injection; ``parallel.batched_sharded``
+shards lanes over a mesh at one psum per iteration; and
+``runtime.compile_cache`` serves arbitrary request sizes from bucketed
+AOT executables of these engines.
+"""
+
+from poisson_ellipse_tpu.batch.batched_pcg import (
+    BatchedPCGResult,
+    batched_operands,
+    pcg_batched,
+)
+from poisson_ellipse_tpu.batch.batched_pipelined import pcg_batched_pipelined
+from poisson_ellipse_tpu.batch.driver import (
+    BATCHED_ENGINES,
+    GuardedBatchedResult,
+    solve_batched,
+)
+
+__all__ = [
+    "BATCHED_ENGINES",
+    "BatchedPCGResult",
+    "GuardedBatchedResult",
+    "batched_operands",
+    "pcg_batched",
+    "pcg_batched_pipelined",
+    "solve_batched",
+]

@@ -1,0 +1,367 @@
+"""Batched classical PCG: B independent solves in ONE fused while_loop.
+
+Every engine in the zoo runs exactly one Poisson solve per dispatch; at
+small grids that leaves the chip dispatch/latency-bound (BENCH_r05:
+1.29 ms at 400×600 — far below what the FLOPs/HBM sustain when lanes are
+stacked). The paper's scheme is embarrassingly batchable: the PCG
+recurrence is identical for every problem, only the fictitious-domain
+operands (a, b, rhs — and through them ε and the geometry) differ. This
+module stacks B such problems on a leading *lane* dimension,
+``(B, M+1, N+1)``, and runs them through ONE ``lax.while_loop``:
+
+- **Per-lane masked updates.** Each lane carries its own scalar
+  recurrence (zr, diff, α, β as (B,) arrays) and its own exit flags.
+  A lane that converges or breaks down is *frozen* — subsequent
+  iterations recompute its updates but discard them via ``where`` — so
+  the loop runs until every lane is done while each lane's trajectory
+  is exactly the single-engine one. Lane arithmetic is never coupled:
+  lane 0 of a batched solve is **bit-identical** to the corresponding
+  single solve (asserted in ``tests/test_batched.py``).
+
+- **Stacked reductions.** The per-iteration dot bundle is computed for
+  all lanes in one pass — ``jnp.sum(u*v, axis=(1, 2))`` stacked into a
+  single ``(k, B)`` reduction — the ``ops.reduction.grid_dots`` idiom
+  widened by a lane axis. On the lane-sharded mesh this is what keeps
+  the collective count flat in B (``parallel.batched_sharded``).
+
+- **In-loop lane quarantine.** A NaN in one lane's carry surfaces in
+  that lane's *scalars* (its dots sum the NaN), so the loop detects a
+  poisoned lane from the (B,) reduction results it already has — zero
+  extra array passes — and masks it out (``quarantined`` flag) instead
+  of letting it spin to the iteration cap and poison the batch's wall
+  clock. The chunked driver (``batch.driver``) surfaces each quarantine
+  as a ``recovery:lane-quarantine`` trace event at the next chunk
+  boundary, reusing the resilience chunk machinery.
+
+- **Bucket embedding.** All shape-dependent scalars (h1, h2, δ, the
+  iteration limit) are accepted as *traced* values, and an optional
+  interior ``mask`` pins nodes outside an embedded true problem to
+  zero — together these let ``runtime.compile_cache`` compile one
+  executable per (bucketed) shape and serve any smaller request from it
+  by pad-and-mask, with no retrace. With ``mask=None`` the traced
+  computation is exactly the unmasked one (no extra ops).
+
+Semantics per lane match ``solver.pcg`` clause for clause (breakdown
+discards its update; a converged iteration keeps it; iteration counts
+include the exiting body).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD
+
+
+class BatchedPCGResult(NamedTuple):
+    """Per-lane solver output: everything ``PCGResult`` reports, plus the
+    quarantine mask (lanes masked out after a non-finite carry)."""
+
+    w: jax.Array           # (B, M+1, N+1) per-lane solutions
+    iters: jax.Array       # (B,) iteration each lane finished at
+    diff: jax.Array        # (B,) final step-norm per lane
+    converged: jax.Array   # (B,) bool
+    breakdown: jax.Array   # (B,) bool
+    quarantined: jax.Array  # (B,) bool — non-finite lane, masked out
+
+
+def _lane_ops(a, b, mask):
+    """Normalise operands to broadcastable lane form.
+
+    ``a``/``b`` may be (g1, g2) — shared geometry across lanes, the
+    common serving case, which also saves their HBM passes — or
+    (B, g1, g2) per-lane (mixed ε / mixed geometry). ``mask`` is an
+    optional (g1, g2) interior indicator for bucket-embedded problems.
+    """
+    a3 = a if a.ndim == 3 else a[None]
+    b3 = b if b.ndim == 3 else b[None]
+    m3 = None if mask is None else mask[None]
+    return a3, b3, m3
+
+
+def apply_a_batched(w, a3, b3, h1, h2):
+    """A·w per lane: (B, g1, g2) iterate, (1|B, g1, g2) coefficients.
+
+    The expression tree mirrors ``ops.stencil.apply_a_block`` term for
+    term (each difference divided by h before combining), so each lane's
+    result is bit-identical to the single-chip stencil's.
+    """
+    wc = w[:, 1:-1, 1:-1]
+    ax = -(
+        a3[:, 2:, 1:-1] * (w[:, 2:, 1:-1] - wc) / h1
+        - a3[:, 1:-1, 1:-1] * (wc - w[:, :-2, 1:-1]) / h1
+    ) / h1
+    ay = -(
+        b3[:, 1:-1, 2:] * (w[:, 1:-1, 2:] - wc) / h2
+        - b3[:, 1:-1, 1:-1] * (wc - w[:, 1:-1, :-2]) / h2
+    ) / h2
+    return jnp.pad(ax + ay, ((0, 0), (1, 1), (1, 1)))
+
+
+def diag_d_batched(a3, b3, h1, h2, mask=None):
+    """Per-lane diagonal of A, zero boundary ring; ``mask`` additionally
+    zeroes it outside an embedded true interior (bucket padding), which
+    makes ``apply_dinv`` pin those nodes to zero for free."""
+    d = (a3[:, 2:, 1:-1] + a3[:, 1:-1, 1:-1]) / (h1 * h1) + (
+        b3[:, 1:-1, 2:] + b3[:, 1:-1, 1:-1]
+    ) / (h2 * h2)
+    d = jnp.pad(d, ((0, 0), (1, 1), (1, 1)))
+    if mask is not None:
+        d = d * mask
+    return d
+
+
+def apply_dinv_batched(r, d):
+    """z = r / D with the zero guard, per lane (broadcasts (1|B, ...))."""
+    safe = jnp.where(d != 0.0, d, 1.0)
+    return jnp.where(d != 0.0, r / safe, 0.0)
+
+
+def lane_dots(*pairs):
+    """All per-lane Σ uᵢ·vᵢ as one stacked (k, B) reduction — the
+    ``grid_dots`` fusion idiom widened by the lane axis. Sums are raw;
+    callers apply their h1·h2 weights, exactly as ``grid_dots``."""
+    return jnp.stack([jnp.sum(u * v, axis=(1, 2)) for u, v in pairs])
+
+
+def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None):
+    """The batched PCG carry at iteration 0.
+
+    Layout: (k, w, r, p, zr, diff, converged, breakdown, quarantined,
+    iters) — the single-engine carry with (B,) per-lane scalars/flags
+    plus the quarantine mask and the per-lane completion counter.
+    ``h1``/``h2`` may be traced overrides (the bucket-generic path);
+    they default to the problem's.
+    """
+    dtype = rhs.dtype
+    B = rhs.shape[0]
+    h1 = jnp.asarray(problem.h1 if h1 is None else h1, dtype)
+    h2 = jnp.asarray(problem.h2 if h2 is None else h2, dtype)
+    a3, b3, m3 = _lane_ops(a, b, mask)
+    d = diag_d_batched(a3, b3, h1, h2, m3)
+    r0 = rhs
+    z0 = apply_dinv_batched(r0, d)
+    zr0 = jnp.sum(z0 * r0, axis=(1, 2)) * h1 * h2
+    return (
+        jnp.asarray(0, jnp.int32),
+        jnp.zeros_like(rhs),
+        r0,
+        z0,  # p0 = z0
+        zr0,
+        jnp.full((B,), jnp.inf, dtype),
+        jnp.zeros((B,), bool),
+        jnp.zeros((B,), bool),
+        jnp.zeros((B,), bool),
+        jnp.zeros((B,), jnp.int32),
+    )
+
+
+def advance(problem: Problem, a, b, rhs, state, limit=None, mask=None,
+            h1=None, h2=None, delta=None, stencil: str = "xla",
+            interpret=None):
+    """Advance the batched carry until every lane is done or iteration
+    ``limit``. Chunked runs (limit=k, k+K, …) are bit-identical to one
+    straight run — the ``solver.pcg.advance`` contract, per lane.
+
+    ``h1``/``h2``/``delta``/``limit`` may all be traced scalars and
+    ``mask`` a traced array: the bucket-generic executable of
+    ``runtime.compile_cache`` is this function compiled once per padded
+    shape, with every size-dependent number fed at dispatch.
+
+    ``stencil="pallas"`` routes A·p through the batched Pallas kernel
+    (lane dimension on the kernel grid, ``ops.pallas_kernels.
+    apply_a_batched_pallas``); it requires lane-shared coefficients and
+    the problem's own concrete grid spacings (the kernel bakes h as
+    compile-time constants).
+    """
+    if stencil == "pallas" and (h1 is not None or h2 is not None):
+        raise ValueError(
+            "the batched Pallas stencil bakes h1/h2 in as compile-time "
+            "constants; traced overrides need stencil='xla' (the "
+            "bucket-generic path)"
+        )
+    dtype = rhs.dtype
+    h1 = jnp.asarray(problem.h1 if h1 is None else h1, dtype)
+    h2 = jnp.asarray(problem.h2 if h2 is None else h2, dtype)
+    delta = jnp.asarray(problem.delta if delta is None else delta, dtype)
+    max_iter = (
+        problem.max_iterations
+        if limit is None
+        else jnp.minimum(
+            jnp.asarray(limit, jnp.int32), problem.max_iterations
+        )
+    )
+    weighted = problem.norm == "weighted"
+    a3, b3, m3 = _lane_ops(a, b, mask)
+    d = diag_d_batched(a3, b3, h1, h2, m3)
+    body = make_lane_step(a3, b3, d, m3, h1, h2, delta, weighted,
+                          stencil=stencil, interpret=interpret,
+                          hs=(problem.h1, problem.h2))
+
+    def cond(state):
+        k, conv, bd, quar = state[0], state[6], state[7], state[8]
+        return (k < max_iter) & jnp.any(~conv & ~bd & ~quar)
+
+    return lax.while_loop(cond, body, state)
+
+
+def make_lane_step(a3, b3, d, m3, h1, h2, delta, weighted,
+                   stencil: str = "xla", interpret=None, hs=None):
+    """One batched-classical iteration as a carry→carry function.
+
+    Factored out of :func:`advance` so the lane-sharded composition
+    (``parallel.batched_sharded``) runs the *identical* per-lane
+    arithmetic inside ``shard_map`` — the loop driver changes, the
+    iteration does not. ``stencil="pallas"`` takes the batched Pallas
+    kernel (``hs`` supplies the concrete (h1, h2) it bakes in; lane-
+    shared coefficients only).
+    """
+    if stencil == "pallas":
+        from poisson_ellipse_tpu.ops.pallas_kernels import (
+            apply_a_batched_pallas,
+        )
+
+        if a3.shape[0] != 1 or b3.shape[0] != 1:
+            raise ValueError(
+                "the batched Pallas stencil streams lane-shared "
+                "coefficients; per-lane (B, g1, g2) a/b need stencil='xla'"
+            )
+        apply_stencil = lambda p: apply_a_batched_pallas(
+            p, a3[0], b3[0], hs[0], hs[1], interpret=interpret
+        )
+    elif stencil == "xla":
+        apply_stencil = lambda p: apply_a_batched(p, a3, b3, h1, h2)
+    else:
+        raise ValueError(f"unknown stencil: {stencil!r}")
+
+    def body(state):
+        k, w, r, p, zr, diff_prev, conv, bd, quar, iters = state
+        active = ~conv & ~bd & ~quar
+        ap = apply_stencil(p)
+        if m3 is not None:
+            # bucket embedding: nodes outside the true interior stay
+            # exactly zero (×1.0 elsewhere — a bitwise identity)
+            ap = ap * m3
+        denom = jnp.sum(ap * p, axis=(1, 2)) * h1 * h2
+        breakdown = denom < DENOM_GUARD
+        alpha = zr / jnp.where(breakdown, 1.0, denom)
+
+        al = alpha[:, None, None]
+        w_new = w + al * p
+        r_new = r - al * ap
+        z = apply_dinv_batched(r_new, d)
+
+        # realised update (w_new − w), one stacked (2, B) reduction —
+        # the grid_dots bundle per lane (solver.pcg.advance's fusion)
+        dw = w_new - w
+        sums = lane_dots((z, r_new), (dw, dw))
+        zr_new = sums[0] * h1 * h2
+        dw2 = sums[1]
+        diff = jnp.sqrt(dw2 * h1 * h2) if weighted else jnp.sqrt(dw2)
+        converged = ~breakdown & (diff < delta)
+        diff = jnp.where(breakdown, diff_prev, diff)
+
+        # lane quarantine from the scalars the reduction already paid
+        # for: a poisoned lane's dots are non-finite, so no extra array
+        # pass is needed to detect it. The lane keeps its pre-fault
+        # carry and drops out of `active`.
+        sick = active & ~(
+            jnp.isfinite(denom) & jnp.isfinite(zr_new) & jnp.isfinite(diff)
+        )
+        breakdown = breakdown & ~sick
+        converged = converged & ~sick
+
+        beta = zr_new / zr
+        p_new = z + beta[:, None, None] * p
+
+        # per-lane freeze masks: an inactive (or newly-sick) lane keeps
+        # its carry; a breakdown lane discards its own update (the
+        # reference exits before touching w/r); a converged lane keeps
+        # the update but freezes p/zr (solver.pcg.advance's where tree)
+        upd = (active & ~breakdown & ~sick)[:, None, None]
+        follow = (active & ~breakdown & ~converged & ~sick)
+        w_out = jnp.where(upd, w_new, w)
+        r_out = jnp.where(upd, r_new, r)
+        p_out = jnp.where(follow[:, None, None], p_new, p)
+        zr_out = jnp.where(follow, zr_new, zr)
+        diff_out = jnp.where(active & ~sick, diff, diff_prev)
+        iters_out = jnp.where(active, k + 1, iters)
+        return (
+            k + 1, w_out, r_out, p_out, zr_out, diff_out,
+            conv | (active & converged),
+            bd | (active & breakdown),
+            quar | sick,
+            iters_out,
+        )
+
+    return body
+
+
+def result_of(state) -> BatchedPCGResult:
+    """View a batched carry as a BatchedPCGResult."""
+    return BatchedPCGResult(
+        w=state[1], iters=state[9], diff=state[5],
+        converged=state[6], breakdown=state[7], quarantined=state[8],
+    )
+
+
+def pcg_batched(problem: Problem, a, b, rhs, mask=None,
+                stencil: str = "xla", interpret=None) -> BatchedPCGResult:
+    """Run batched PCG for pre-assembled operands.
+
+    ``rhs`` is (B, M+1, N+1); ``a``/``b`` are (M+1, N+1) shared or
+    (B, M+1, N+1) per-lane. Jit-safe with ``problem`` static.
+    ``stencil``: "xla" (default, any operands) or "pallas" (the batched
+    lane-on-grid kernel; shared coefficients, f32/bf16 on hardware).
+    """
+    state = advance(
+        problem, a, b, rhs, init_state(problem, a, b, rhs, mask=mask),
+        mask=mask, stencil=stencil, interpret=interpret,
+    )
+    return result_of(state)
+
+
+def batched_operands(problem: Problem, lanes: int, dtype=jnp.float32,
+                     eps_values=None):
+    """Assemble (a, b, rhs) for a ``lanes``-wide batch of this problem.
+
+    With ``eps_values`` (length ``lanes``) each lane gets its own
+    fictitious-domain ε — per-lane (B, g1, g2) coefficients; otherwise
+    the geometry is shared and a/b stay (g1, g2) (the cheaper layout).
+    The RHS is the problem's, tiled: the throughput protocol solves B
+    identical systems, which is measurement-honest because lanes never
+    share arithmetic (no CSE is possible across the lane axis of one
+    array).
+    """
+    import numpy as np
+
+    from poisson_ellipse_tpu.ops import assembly
+
+    if eps_values is not None:
+        if len(eps_values) != lanes:
+            raise ValueError(
+                f"eps_values has {len(eps_values)} entries for {lanes} lanes"
+            )
+        abrs = [
+            assembly.assemble_numpy(
+                Problem(
+                    M=problem.M, N=problem.N, a1=problem.a1, b1=problem.b1,
+                    a2=problem.a2, b2=problem.b2, f_val=problem.f_val,
+                    delta=problem.delta, norm=problem.norm, eps=eps,
+                    max_iter=problem.max_iter,
+                )
+            )
+            for eps in eps_values
+        ]
+        np_dtype = assembly.numpy_dtype(dtype)
+        a = jnp.asarray(np.stack([x[0] for x in abrs]).astype(np_dtype))
+        b = jnp.asarray(np.stack([x[1] for x in abrs]).astype(np_dtype))
+        rhs = jnp.asarray(np.stack([x[2] for x in abrs]).astype(np_dtype))
+        return a, b, rhs
+    a, b, rhs = assembly.assemble(problem, dtype)
+    return a, b, jnp.broadcast_to(rhs, (lanes,) + rhs.shape)

@@ -1,0 +1,291 @@
+"""Batched pipelined PCG: B lanes, one stacked (8, B) dot bundle/iter.
+
+The Ghysels–Vanroose recurrence (``ops.pipelined_pcg``) widened by a
+lane axis: every inner product of an iteration is a function of vectors
+already in hand, so the whole batch's bundle — 8 dots × B lanes — rides
+ONE stacked reduction, and the iteration's stencil applications have no
+data dependence on it. That is the property that keeps the lane-sharded
+mesh composition at exactly **one psum per iteration regardless of B**
+(``parallel.batched_sharded``): per-lane bundles need no collective at
+all (lanes live whole on their device), and the single psum that
+synchronises the loop is independent of the lane count.
+
+Per-lane semantics are ``ops.pipelined_pcg``'s: the expanded
+α-denominator (not the cancellation-prone scalar recursion), breakdown
+under ``DENOM_GUARD`` discarding the iteration's update, fixed-cadence
+residual replacement every ``REPLACE_EVERY`` iterations (keyed on the
+global counter, so chunked runs stay bit-identical), and the ±2-of-
+classical iteration-count contract. Lane freezing, in-loop quarantine
+and the bucket-embedding mask follow ``batch.batched_pcg``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from poisson_ellipse_tpu.batch.batched_pcg import (
+    BatchedPCGResult,
+    _lane_ops,
+    apply_a_batched,
+    apply_dinv_batched,
+    diag_d_batched,
+    lane_dots,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.pipelined_pcg import REPLACE_EVERY
+from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD
+
+
+def _bundle(r, u, w, s, p):
+    """The iteration's eight dot pairs per lane, in
+    ``ops.pipelined_pcg._bundle`` order: γ, the four α-denominator
+    terms, the three ‖Δx‖-recurrence terms."""
+    return (
+        (r, u),
+        (w, u), (w, p), (s, u), (s, p),
+        (u, u), (u, p), (p, p),
+    )
+
+
+def _stencil_closure(a3, b3, m3, h1, h2, stencil, interpret, hs):
+    """The per-lane A·(·) closure: "xla" broadcasts through
+    ``apply_a_batched``; "pallas" runs the lane-on-grid batched kernel
+    (lane-shared coefficients, concrete ``hs`` baked in)."""
+    if stencil == "pallas":
+        from poisson_ellipse_tpu.ops.pallas_kernels import (
+            apply_a_batched_pallas,
+        )
+
+        if a3.shape[0] != 1 or b3.shape[0] != 1:
+            raise ValueError(
+                "the batched Pallas stencil streams lane-shared "
+                "coefficients; per-lane (B, g1, g2) a/b need stencil='xla'"
+            )
+
+        def fn(v):
+            out = apply_a_batched_pallas(
+                v, a3[0], b3[0], hs[0], hs[1], interpret=interpret
+            )
+            return out if m3 is None else out * m3
+
+        return fn
+    if stencil != "xla":
+        raise ValueError(f"unknown stencil: {stencil!r}")
+
+    def fn(v):
+        out = apply_a_batched(v, a3, b3, h1, h2)
+        return out if m3 is None else out * m3
+
+    return fn
+
+
+def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None,
+               stencil: str = "xla", interpret=None):
+    """The batched pipelined carry at iteration 0: (k, x, r, u, w, z, s,
+    p, γ₋₁, diff, converged, breakdown, quarantined, iters) with (B,)
+    per-lane scalars/flags."""
+    dtype = rhs.dtype
+    B = rhs.shape[0]
+    h1 = jnp.asarray(problem.h1 if h1 is None else h1, dtype)
+    h2 = jnp.asarray(problem.h2 if h2 is None else h2, dtype)
+    a3, b3, m3 = _lane_ops(a, b, mask)
+    d = diag_d_batched(a3, b3, h1, h2, m3)
+    stencil = _stencil_closure(
+        a3, b3, m3, h1, h2, stencil, interpret, (problem.h1, problem.h2)
+    )
+
+    r0 = rhs
+    u0 = apply_dinv_batched(r0, d)
+    w0 = stencil(u0)
+    zeros = jnp.zeros_like(rhs)
+    return (
+        jnp.asarray(0, jnp.int32),
+        zeros,  # x
+        r0,
+        u0,
+        w0,
+        zeros,  # z
+        zeros,  # s
+        zeros,  # p
+        jnp.ones((B,), dtype),          # γ of the previous iteration
+        jnp.full((B,), jnp.inf, dtype),
+        jnp.zeros((B,), bool),
+        jnp.zeros((B,), bool),
+        jnp.zeros((B,), bool),
+        jnp.zeros((B,), jnp.int32),
+    )
+
+
+def advance(problem: Problem, a, b, rhs, state, limit=None, mask=None,
+            h1=None, h2=None, delta=None, stencil: str = "xla",
+            interpret=None):
+    """Advance the batched pipelined carry until every lane is done or
+    iteration ``limit``. Same traced-scalar/bucket-mask contract as
+    ``batch.batched_pcg.advance``; chunked runs are bit-identical to a
+    straight run (residual replacement keys on the global counter).
+    ``stencil="pallas"`` fuses each iteration's stencil with its whole
+    (8, B) dot bundle in one kernel launch
+    (``ops.pallas_kernels.apply_a_dots_batched_pallas``)."""
+    if stencil == "pallas" and (h1 is not None or h2 is not None):
+        raise ValueError(
+            "the batched Pallas kernels bake h1/h2 in as compile-time "
+            "constants; traced overrides need stencil='xla'"
+        )
+    dtype = rhs.dtype
+    h1 = jnp.asarray(problem.h1 if h1 is None else h1, dtype)
+    h2 = jnp.asarray(problem.h2 if h2 is None else h2, dtype)
+    delta = jnp.asarray(problem.delta if delta is None else delta, dtype)
+    max_iter = (
+        problem.max_iterations
+        if limit is None
+        else jnp.minimum(
+            jnp.asarray(limit, jnp.int32), problem.max_iterations
+        )
+    )
+    weighted = problem.norm == "weighted"
+    a3, b3, m3 = _lane_ops(a, b, mask)
+    d = diag_d_batched(a3, b3, h1, h2, m3)
+    body = make_lane_step(rhs, a3, b3, d, m3, h1, h2, delta, weighted,
+                          stencil=stencil, interpret=interpret,
+                          hs=(problem.h1, problem.h2))
+
+    def cond(state):
+        k, conv, bd, quar = state[0], state[10], state[11], state[12]
+        return (k < max_iter) & jnp.any(~conv & ~bd & ~quar)
+
+    return lax.while_loop(cond, body, state)
+
+
+def make_lane_step(rhs, a3, b3, d, m3, h1, h2, delta, weighted,
+                   stencil: str = "xla", interpret=None, hs=None):
+    """One batched-pipelined iteration as a carry→carry function —
+    factored for the lane-sharded composition, exactly like
+    ``batched_pcg.make_lane_step``. ``stencil="pallas"`` streams the
+    iteration's stencil AND its (8, B) bundle through the fused
+    lane-on-grid kernel in one VMEM pass."""
+    hw = h1 * h2
+    pallas = stencil == "pallas"
+    stencil = _stencil_closure(a3, b3, m3, h1, h2, stencil, interpret, hs)
+
+    if pallas:
+        from poisson_ellipse_tpu.ops.pallas_kernels import (
+            apply_a_dots_batched_pallas,
+        )
+
+        def stencil_and_dots(m, r, u, w, s, p):
+            # one launch: n = A·m AND the eight per-lane dot partials,
+            # every operand read from HBM exactly once
+            n, sums = apply_a_dots_batched_pallas(
+                m, a3[0], b3[0], hs[0], hs[1], _bundle(r, u, w, s, p),
+                interpret=interpret,
+            )
+            return (n if m3 is None else n * m3), sums
+
+    else:
+
+        def stencil_and_dots(m, r, u, w, s, p):
+            return stencil(m), lane_dots(*_bundle(r, u, w, s, p))
+
+    def replace(k, x, r, u, w, z, s, p):
+        """Residual replacement from ground-truth x and p (4 stencils),
+        fixed cadence, all lanes at once."""
+
+        def rebuilt(_):
+            r_t = rhs - stencil(x)
+            u_t = apply_dinv_batched(r_t, d)
+            s_t = stencil(p)
+            return (
+                r_t, u_t, stencil(u_t),
+                stencil(apply_dinv_batched(s_t, d)), s_t,
+            )
+
+        do = (k > 0) & (k % REPLACE_EVERY == 0)
+        return lax.cond(do, rebuilt, lambda _: (r, u, w, z, s), None)
+
+    def body(state):
+        (k, x, r, u, w, z, s, p, g_prev, diff_prev,
+         conv, bd, quar, iters) = state
+        active = ~conv & ~bd & ~quar
+        r, u, w, z, s = replace(k, x, r, u, w, z, s, p)
+
+        # the iteration's ONE stacked (8, B) reduction; the stencil
+        # consumes none of it (the overlap property the sharded
+        # composition relies on) — under "pallas" both ride one fused
+        # kernel launch
+        m = apply_dinv_batched(w, d)
+        n, sums = stencil_and_dots(m, r, u, w, s, p)
+
+        gamma = sums[0] * hw
+        wu, wp, su, sp = sums[1], sums[2], sums[3], sums[4]
+        uu, up, pp = sums[5], sums[6], sums[7]
+
+        first = k == 0
+        beta = jnp.where(first, 0.0, gamma / jnp.where(first, 1.0, g_prev))
+        denom = (wu + beta * (wp + su) + beta * beta * sp) * hw
+        breakdown = denom < DENOM_GUARD
+        alpha = gamma / jnp.where(breakdown, 1.0, denom)
+
+        be = beta[:, None, None]
+        al = alpha[:, None, None]
+        z_new = n + be * z
+        s_new = w + be * s
+        p_new = u + be * p
+        x_new = x + al * p_new
+        r_new = r - al * s_new
+        u_new = u - al * apply_dinv_batched(s_new, d)
+        w_new = w - al * z_new
+
+        pp_new = uu + 2.0 * beta * up + beta * beta * pp
+        dw2 = alpha * alpha * pp_new
+        diff = jnp.sqrt(dw2 * hw) if weighted else jnp.sqrt(dw2)
+        converged = ~breakdown & (diff < delta)
+        diff = jnp.where(breakdown, diff_prev, diff)
+
+        # lane quarantine from the scalars already in hand (a poisoned
+        # lane's bundle is non-finite) — batched_pcg's contract
+        sick = active & ~(
+            jnp.isfinite(gamma) & jnp.isfinite(denom) & jnp.isfinite(diff)
+        )
+        breakdown = breakdown & ~sick
+        converged = converged & ~sick
+
+        upd = (active & ~breakdown & ~sick)[:, None, None]
+        keep = lambda old, new: jnp.where(upd, new, old)
+        follow = active & ~breakdown & ~sick
+        return (
+            k + 1,
+            keep(x, x_new), keep(r, r_new), keep(u, u_new), keep(w, w_new),
+            keep(z, z_new), keep(s, s_new), keep(p, p_new),
+            jnp.where(follow, gamma, g_prev),
+            jnp.where(active & ~sick, diff, diff_prev),
+            conv | (active & converged),
+            bd | (active & breakdown),
+            quar | sick,
+            jnp.where(active, k + 1, iters),
+        )
+
+    return body
+
+
+def result_of(state) -> BatchedPCGResult:
+    """View a batched pipelined carry as a BatchedPCGResult."""
+    return BatchedPCGResult(
+        w=state[1], iters=state[13], diff=state[9],
+        converged=state[10], breakdown=state[11], quarantined=state[12],
+    )
+
+
+def pcg_batched_pipelined(problem: Problem, a, b, rhs, mask=None,
+                          stencil: str = "xla",
+                          interpret=None) -> BatchedPCGResult:
+    """Run batched pipelined PCG for pre-assembled operands (the
+    ``batch.batched_pcg.pcg_batched`` contract, pipelined recurrence;
+    ``stencil="pallas"`` takes the fused lane-on-grid kernel)."""
+    state = advance(
+        problem, a, b, rhs,
+        init_state(problem, a, b, rhs, mask=mask, stencil=stencil,
+                   interpret=interpret),
+        mask=mask, stencil=stencil, interpret=interpret,
+    )
+    return result_of(state)

@@ -1,0 +1,201 @@
+"""Chunked batched solves: quarantine as an *observed* event.
+
+The batched engines already mask a poisoned lane out in-loop
+(``batch.batched_pcg``: the quarantine test rides the scalars the dot
+bundle computes anyway). What the fused loop cannot do is *tell anyone*:
+a serving stack needs the quarantine on the wire — which lane, at which
+iteration — and fault injection needs an exact iteration to corrupt the
+carry at. Both are chunk-boundary jobs, and the resilience guard
+(``resilience.guard``) already built that machinery: run the production
+``advance`` in chunks (bit-identical to a straight run — chunking only
+moves the while_loop boundary), read a tiny health word between chunks,
+record ``recovery:*`` trace events through the same ``_record`` helper.
+
+This driver reuses exactly that: per chunk, ONE host read of the
+per-lane flag vector; each newly-quarantined lane emits a
+``recovery:lane-quarantine`` event (the guard's event schema, lane in
+the detail); ``FaultPlan``s inject lane-addressed faults at exact
+iterations (``resilience.faultinject.Fault(lane=...)``); ``timeout``
+cancels gracefully at a chunk boundary. Healthy lanes are untouched by
+any of it — their trajectory is the fused single-dispatch one.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.batch import batched_pcg, batched_pipelined
+from poisson_ellipse_tpu.batch.batched_pcg import (
+    BatchedPCGResult,
+    batched_operands,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.resilience.faultinject import FaultPlan
+from poisson_ellipse_tpu.resilience.guard import (
+    DEFAULT_CHUNK,
+    HEALTH_NONFINITE,
+    RecoveryEvent,
+    _check_deadline,
+    _record,
+)
+
+# the lane-batched engine names: the registry (solver.engine) is the
+# single source of truth; re-exported here for the batch package surface
+from poisson_ellipse_tpu.solver.engine import BATCHED_ENGINES  # noqa: E402
+
+# carry-layout tables per engine: field-name → index (the FaultPlan
+# addressing contract shared with resilience.guard's adapters), plus the
+# per-lane flag/counter slots the driver reads between chunks
+_LAYOUT = {
+    "batched": {
+        "module": batched_pcg,
+        "fields": {"w": 1, "r": 2, "p": 3, "zr": 4},
+        "zr": 4, "conv": 6, "bd": 7, "quar": 8, "iters": 9,
+    },
+    "batched-pipelined": {
+        "module": batched_pipelined,
+        "fields": {
+            "x": 1, "r": 2, "u": 3, "w": 4, "z": 5, "s": 6, "p": 7,
+            "gamma": 8,
+        },
+        "zr": 8, "conv": 10, "bd": 11, "quar": 12, "iters": 13,
+    },
+}
+
+
+@functools.lru_cache(maxsize=32)
+def _chunk_advance(engine: str, problem: Problem, masked: bool):
+    """One jitted chunk-advance per (engine, problem, mask-arity),
+    operands and bound passed as traced arguments — repeated
+    ``solve_batched`` calls for the same problem reuse the compiled
+    advance instead of retracing per request (the per-request
+    recompile hazard tpulint TPU010 fences)."""
+    mod = _LAYOUT[engine]["module"]
+    if masked:
+
+        def fn(a, b, rhs, state, lim, mask):
+            return mod.advance(
+                problem, a, b, rhs, state, limit=lim, mask=mask
+            )
+
+    else:
+
+        def fn(a, b, rhs, state, lim):
+            return mod.advance(problem, a, b, rhs, state, limit=lim)
+
+    # no donation: operands are re-fed every chunk, and the in carry is
+    # the caller's pre-fault rollback reference
+    return jax.jit(fn)  # tpulint: disable=TPU004
+
+
+class GuardedBatchedResult(NamedTuple):
+    """A chunked batched solve's outcome: per-lane results plus the
+    quarantine story (empty ``recoveries`` = every lane ran healthy)."""
+
+    result: BatchedPCGResult
+    recoveries: tuple[RecoveryEvent, ...]
+    engine: str
+
+
+def solve_batched(
+    problem: Problem,
+    lanes: int,
+    engine: str = "batched",
+    dtype=jnp.float32,
+    *,
+    operands=None,
+    mask=None,
+    chunk: int = DEFAULT_CHUNK,
+    faults: Optional[FaultPlan] = None,
+    timeout: Optional[float] = None,
+) -> GuardedBatchedResult:
+    """One chunked batched solve with lane-quarantine reporting.
+
+    ``operands`` is an optional pre-assembled (a, b, rhs) triple (rhs
+    lane-stacked); by default the problem is assembled and its RHS tiled
+    over ``lanes``. ``faults`` injects lane-addressed carry corruption
+    at exact iterations (``Fault(kind="nan", at_iter=k, lane=j)``).
+    """
+    if engine not in _LAYOUT:
+        raise ValueError(
+            f"unknown batched engine {engine!r} (one of {BATCHED_ENGINES})"
+        )
+    if chunk < 1:
+        raise ValueError("chunk must be >= 1")
+    lay = _LAYOUT[engine]
+    mod = lay["module"]
+    a, b, rhs = (
+        operands if operands is not None
+        else batched_operands(problem, lanes, dtype)
+    )
+    if rhs.shape[0] != lanes:
+        raise ValueError(
+            f"rhs carries {rhs.shape[0]} lanes, expected {lanes}"
+        )
+    plan = faults if faults is not None else FaultPlan()
+    for fault in plan.faults:
+        if fault.lane is None:
+            raise ValueError(
+                "batched carries hold per-lane state: faults must be "
+                "lane-addressed (Fault(..., lane=j)) so the corruption "
+                "lands on one lane's slice"
+            )
+        if not 0 <= fault.lane < lanes:
+            raise ValueError(
+                f"fault lane {fault.lane} outside the {lanes}-lane batch"
+            )
+    events: list[RecoveryEvent] = []
+    t0 = time.monotonic()
+
+    # one compiled advance for every chunk AND every later call with the
+    # same (engine, problem): operands/bound are traced arguments, the
+    # jitted callable is lru-cached — no recompile per chunk or per
+    # request (the resilience adapters' stance, made cross-call)
+    masked = mask is not None
+    chunk_fn = _chunk_advance(engine, problem, masked)
+    if masked:
+        advance = lambda st, lim: chunk_fn(a, b, rhs, st, lim, mask)
+    else:
+        advance = lambda st, lim: chunk_fn(a, b, rhs, st, lim)
+    state = mod.init_state(problem, a, b, rhs, mask=mask)
+    k = 0
+    max_iter = problem.max_iterations
+    quar_seen = np.zeros((lanes,), bool)
+
+    while True:
+        _check_deadline(timeout, t0, k)
+        stop = plan.next_stop(k - 1)  # a fault AT k fires before this chunk
+        limit = min(k + chunk, max_iter)
+        if stop is not None and k < stop:
+            limit = min(limit, stop)
+        run_state = plan.apply(
+            k, state, lay["fields"], lay["bd"], lay["zr"]
+        ) if plan else state
+        state = advance(run_state, limit)
+        # ONE host read per chunk: the per-lane flag vector (the guard's
+        # health-word stance, vectorised over lanes)
+        k = int(state[0])
+        conv = np.asarray(state[lay["conv"]])
+        bd = np.asarray(state[lay["bd"]])
+        quar = np.asarray(state[lay["quar"]])
+        iters = np.asarray(state[lay["iters"]])
+        for lane in np.flatnonzero(quar & ~quar_seen):
+            _record(
+                events, "lane-quarantine", int(iters[lane]),
+                HEALTH_NONFINITE, engine, detail=f"lane {int(lane)}",
+            )
+        quar_seen = quar
+        if k >= max_iter or bool(np.all(conv | bd | quar)):
+            break
+
+    return GuardedBatchedResult(
+        result=mod.result_of(state),
+        recoveries=tuple(events),
+        engine=engine,
+    )

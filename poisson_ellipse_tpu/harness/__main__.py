@@ -18,6 +18,18 @@ Two observability entries ride the same prog:
   executed — ``python -m poisson_ellipse_tpu.harness inspect pipelined
   --mode sharded --mesh 1 2``.
 
+The serving surface:
+
+- ``--lanes N`` runs N independent solves inside ONE dispatch via the
+  lane-batched engines (real batching — ``--batch`` is only the chained
+  TIMING protocol and never puts more work on the chip); reports carry
+  aggregate solves/sec and per-lane quarantine counts.
+- ``warmup`` is the cache subcommand: wire the persistent XLA
+  compilation cache and AOT-compile bucketed batched executables so
+  arbitrary request sizes hit a warm executable —
+  ``python -m poisson_ellipse_tpu.harness warmup --grids 400x600
+  --lanes 1,8 --engine both``.
+
 And the resilience surface:
 
 - ``--guard`` routes the solve through ``resilience.guard`` (chunked
@@ -283,12 +295,130 @@ def _report_inject(args, guarded) -> int:
     return 0 if record["converged"] else 1
 
 
+def _run_warmup(argv: list[str]) -> int:
+    """The ``warmup`` subcommand: pre-fill the compilation caches.
+
+    Wires up the persistent XLA cache and AOT-compiles the batched
+    engines' bucket executables for the requested grids/lane counts
+    (``runtime.compile_cache``), so a serving worker's first real
+    request is a cache hit instead of a cold compile. Hit/miss counts
+    land on the trace (``cache:hit`` / ``cache:miss`` events).
+    """
+    from poisson_ellipse_tpu.runtime import compile_cache
+
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness warmup",
+        description="Warm the compilation caches: enable the persistent "
+        "XLA cache and AOT-compile bucketed executables for the batched "
+        "engines, keyed (engine, grid-bucket, dtype, lane-bucket). "
+        "Arbitrary request sizes then hit a warm executable by "
+        "pad-and-mask embedding.",
+    )
+    ap.add_argument(
+        "--grids", default="40x40",
+        help="comma list of MxN grids to warm buckets for",
+    )
+    ap.add_argument(
+        "--lanes", default="1,8",
+        help="comma list of lane counts (each rounds up to its bucket)",
+    )
+    ap.add_argument(
+        "--engine", default="batched",
+        choices=("batched", "batched-pipelined", "both"),
+    )
+    ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument(
+        "--cache-dir", default=None,
+        help="persistent XLA cache directory (default: "
+        "$POISSON_COMPILE_CACHE or ~/.cache/poisson_ellipse_tpu/xla)",
+    )
+    ap.add_argument(
+        "--no-persistent", action="store_true",
+        help="skip the persistent XLA cache wiring (in-process pool only)",
+    )
+    ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        obs_trace.start(args.trace)
+    try:
+        if not args.no_persistent:
+            cache_dir = compile_cache.enable_persistent_cache(args.cache_dir)
+        else:
+            cache_dir = None
+        engines = (
+            ("batched", "batched-pipelined")
+            if args.engine == "both"
+            else (args.engine,)
+        )
+        try:
+            grids = [
+                (int(m), int(n or m))
+                for m, _, n in (
+                    spec.lower().partition("x")
+                    for spec in args.grids.split(",")
+                )
+            ]
+            lane_counts = [int(x) for x in args.lanes.split(",")]
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        pool = compile_cache.warm_pool()
+        rows = []
+        dtype = resolve_dtype(args.dtype)
+        for engine in engines:
+            for grid in grids:
+                for lanes in lane_counts:
+                    entry = pool.warmup(engine, grid, dtype, lanes)
+                    rows.append({
+                        "engine": engine,
+                        "grid": list(grid),
+                        "bucket": list(entry.bucket),
+                        "lanes": lanes,
+                        "lane_bucket": entry.lanes,
+                        "compile_s": round(entry.compile_s, 4),
+                    })
+        record = {
+            "persistent_dir": cache_dir,
+            "warmed": rows,
+            "hits": pool.hits,
+            "misses": pool.misses,
+        }
+        obs_trace.event("warmup_report", **record)
+        if args.json:
+            print(json.dumps(record))
+        else:
+            for row in rows:
+                print(
+                    f"warm {row['engine']:18s} {row['grid'][0]}x"
+                    f"{row['grid'][1]} -> bucket {row['bucket'][0]}x"
+                    f"{row['bucket'][1]} lanes {row['lanes']} -> "
+                    f"{row['lane_bucket']}  compile "
+                    + (
+                        f"{row['compile_s']:.3f}s"
+                        if row["compile_s"] else "cached"
+                    )
+                )
+            print(
+                f"warm pool: {pool.misses} compiled, {pool.hits} already "
+                "warm"
+                + (f"; persistent cache at {cache_dir}" if cache_dir else "")
+            )
+        return 0
+    finally:
+        if args.trace:
+            obs_trace.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "inspect":
         return _run_inspect(argv[1:])
     if argv and argv[0] == "inject":
         return _run_inject(argv[1:])
+    if argv and argv[0] == "warmup":
+        return _run_warmup(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.harness",
         description="Fictitious-domain Poisson PCG on TPU",
@@ -313,10 +443,13 @@ def main(argv=None) -> int:
         "xla); fused is the two-kernel "
         "HBM iteration, pallas the per-op stencil kernel, pipelined the "
         "one-fused-reduction-per-iteration recurrence (pipelined-pallas: "
-        "same loop through the fused stencil+partials kernel). Sharded "
+        "same loop through the fused stencil+partials kernel); batched/"
+        "batched-pipelined run --lanes independent solves per dispatch "
+        "(the throughput engines, per-lane results). Sharded "
         "mode: xla (default), pallas (the per-shard stencil kernel), "
-        "fused (the two-kernel per-shard iteration, f32/bf16), or "
-        "pipelined (one stacked psum per iteration)",
+        "fused (the two-kernel per-shard iteration, f32/bf16), "
+        "pipelined (one stacked psum per iteration), or batched/"
+        "batched-pipelined with --lanes sharded over the mesh",
     )
     ap.add_argument(
         "--threads",
@@ -359,7 +492,20 @@ def main(argv=None) -> int:
         "--batch",
         type=int,
         default=1,
-        help="dispatches per repetition (amortises host<->device RTT)",
+        help="TIMING protocol: dispatches chained per repetition so the "
+        "fixed host<->device RTT cancels out of T_solver. This does NOT "
+        "batch solves onto the chip — that is --lanes",
+    )
+    ap.add_argument(
+        "--lanes",
+        type=int,
+        default=1,
+        help="REAL lane batching: run N independent solves inside one "
+        "dispatch via the batched engines (--engine batched/"
+        "batched-pipelined; auto resolves to batched when N > 1). "
+        "Reports per-dispatch T_solver plus aggregate solves/sec. "
+        "Distinct from --batch, which only chains dispatches to time "
+        "them",
     )
     ap.add_argument(
         "--checkpoint-dir",
@@ -526,6 +672,7 @@ def _run_cli(args) -> int:
                         engine=args.engine,
                         repeat=args.repeat,
                         batch=args.batch,
+                        lanes=args.lanes,
                         threads=args.threads,
                         checkpoint_dir=ck_dir,
                         chunk=args.chunk,

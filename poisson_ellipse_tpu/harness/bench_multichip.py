@@ -152,6 +152,112 @@ def _static_collectives(base_grid, mesh_shape, dtype: str, stencil_impl: str):
     }
 
 
+def throughput_table(
+    base_grid: tuple[int, int],
+    meshes: list[tuple[int, int]],
+    lanes_per_device: int = 2,
+    dtype: str = "f32",
+    pipelined: bool = False,
+    repeat: int = 1,
+) -> dict:
+    """Lane-sharded throughput series: solves/sec as the mesh grows.
+
+    Each mesh (px, py) solves the SAME grid with ``lanes_per_device``
+    whole lanes per device (``parallel.batched_sharded``) — the serving
+    scale-out axis, where ideal scaling is aggregate solves/sec
+    proportional to the device count at exactly 1 psum/iteration.
+    ``scaling`` is solves/sec relative to the first row; ``efficiency``
+    divides that by the device-count ratio (ideal 1.0).
+    """
+    M0, N0 = base_grid
+    engine = "batched-pipelined" if pipelined else "batched"
+    rows = []
+    sps_first = None
+    first_row = True
+    devices_first = meshes[0][0] * meshes[0][1]
+    for px, py in meshes:
+        devices = px * py
+        lanes = lanes_per_device * devices
+        report = run_once(
+            Problem(M=M0, N=N0),
+            mode="sharded",
+            mesh_shape=(px, py),
+            dtype=dtype,
+            engine=engine,
+            lanes=lanes,
+            repeat=repeat,
+        )
+        sps = report.solves_per_sec or 0.0
+        # relative columns stay honest when the first row failed: later
+        # rows carry None rather than silently rebasing on themselves
+        if first_row:
+            scaling = 1.0 if sps else None
+        else:
+            scaling = sps / sps_first if sps_first else None
+        rows.append({
+            "grid": f"{M0}x{N0}",
+            "mesh": [px, py],
+            "devices": devices,
+            "lanes": lanes,
+            "iters": report.iters,
+            "converged": report.converged,
+            "t_solver_s": round(report.t_solver, 6),
+            "solves_per_sec": round(sps, 3),
+            "scaling": round(scaling, 3) if scaling is not None else None,
+            "efficiency": (
+                round(scaling * devices_first / devices, 3)
+                if scaling is not None
+                else None
+            ),
+        })
+        if first_row:
+            sps_first = sps or None
+            first_row = False
+    return {
+        "kind": "throughput",
+        "base_grid": f"{M0}x{N0}",
+        "dtype": dtype,
+        "engine": engine,
+        "lanes_per_device": lanes_per_device,
+        "rows": rows,
+        "iters_consistent": len({r["iters"] for r in rows}) <= 1,
+        "collectives_per_iter": _static_collectives_batched(
+            base_grid, meshes[0], lanes_per_device, dtype, pipelined
+        ),
+    }
+
+
+def _static_collectives_batched(base_grid, mesh_shape, lanes_per_device,
+                                dtype: str, pipelined: bool):
+    """psum/ppermute per while-body of the lane-sharded solver — the
+    1-psum-per-iteration property carried in the artifact (None when the
+    mesh cannot be traced)."""
+    from poisson_ellipse_tpu.harness.run import resolve_dtype, resolve_mesh
+    from poisson_ellipse_tpu.obs import static_cost
+    from poisson_ellipse_tpu.parallel.batched_sharded import (
+        build_batched_sharded_solver,
+    )
+
+    try:
+        mesh = resolve_mesh(tuple(mesh_shape))
+        solver, args = build_batched_sharded_solver(
+            Problem(M=base_grid[0], N=base_grid[1]),
+            mesh,
+            lanes_per_device * mesh_shape[0] * mesh_shape[1],
+            resolve_dtype(dtype),
+            pipelined=pipelined,
+        )
+        counts = static_cost.loop_primitive_counts(
+            solver, args, static_cost.COLLECTIVE_PRIMS
+        )
+    except Exception:  # tpulint: disable=TPU009 — accounting must never fail a bench
+        return None
+    return {
+        "psum": counts["psum"] + counts["psum_invariant"],
+        "ppermute": counts["ppermute"],
+    }
+
+
 def parse_meshes(spec: str) -> list[tuple[int, int]]:
     """'1x1,2x2,2x4' -> [(1,1), (2,2), (2,4)]."""
     out = []

@@ -27,7 +27,7 @@ from poisson_ellipse_tpu.resilience.errors import (
     OutOfMemoryError,
     is_oom_error,
 )
-from poisson_ellipse_tpu.solver.engine import build_solver
+from poisson_ellipse_tpu.solver.engine import BATCHED_ENGINES, build_solver
 from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
 from poisson_ellipse_tpu.utils.timing import PhaseTimer, fence
 
@@ -111,6 +111,13 @@ class RunReport:
     # recovery actions a guarded run applied (resilience.guard event
     # kinds, in order); empty = the healthy path ran start to finish
     recoveries: list[str] = field(default_factory=list)
+    # lane width of a batched run (--lanes; 1 = the single-solve
+    # protocol) and the aggregate throughput it achieved: lanes divided
+    # by the per-dispatch T_solver. quarantined counts lanes masked out
+    # after a non-finite carry (batch.batched_pcg)
+    lanes: int = 1
+    solves_per_sec: float | None = None
+    quarantined: int = 0
 
     def summary(self) -> str:
         p = self.problem
@@ -140,6 +147,16 @@ class RunReport:
             ),
             f"L2 error vs analytic: {self.l2_error:.6e}",
         ]
+        if self.lanes > 1:
+            lines.append(
+                f"Lanes: {self.lanes}  "
+                f"throughput {self.solves_per_sec:.2f} solves/s"
+                + (
+                    f"  ({self.quarantined} lane(s) quarantined)"
+                    if self.quarantined
+                    else ""
+                )
+            )
         if self.recoveries:
             lines.append(
                 f"Recoveries: {len(self.recoveries)} "
@@ -154,7 +171,9 @@ class RunReport:
         """One-line roofline summary, '' when the model does not apply
         (native host runs, zero timed iterations)."""
         n = self.timed_iters if self.timed_iters is not None else self.iters
-        if not n or self.engine == "native":
+        if not n or self.engine == "native" or self.lanes > 1:
+            # lane-batched runs report throughput (solves/sec), not the
+            # single-solve HBM traffic model
             return ""
         if self.passes_per_iter == 0:
             # the engine left the HBM roofline entirely: its working set is
@@ -196,6 +215,15 @@ class RunReport:
             "hbm_peak_frac": self.hbm_peak_frac,
             **({"threads": self.threads} if self.engine == "native" else {}),
             **({"recoveries": self.recoveries} if self.recoveries else {}),
+            **(
+                {
+                    "lanes": self.lanes,
+                    "solves_per_sec": self.solves_per_sec,
+                    "quarantined": self.quarantined,
+                }
+                if self.lanes > 1
+                else {}
+            ),
         }
 
 
@@ -207,6 +235,7 @@ def run_once(
     engine: str = "auto",
     repeat: int = 1,
     batch: int = 1,
+    lanes: int = 1,
     threads: int = 0,
     checkpoint_dir: str | None = None,
     chunk: int = 500,
@@ -235,6 +264,13 @@ def run_once(
     back-to-back dispatches each; T_solver is the median per-dispatch
     time.
 
+    lanes: lane width for the batched engines — ``lanes`` independent
+    solves ride ONE dispatch (``--lanes``; distinct from ``batch``,
+    which chains *dispatches* purely as a timing protocol). With
+    lanes > 1 the engine must be ``batched``/``batched-pipelined``
+    (``auto`` resolves to ``batched``) and the report carries per-lane
+    aggregates plus ``solves_per_sec = lanes / T_solver``.
+
     timeout/guard/max_recoveries: the resilience surface. ``guard=True``
     (or any ``timeout``) routes the solve through
     ``resilience.guard.guarded_solve`` — chunked execution, per-chunk
@@ -244,6 +280,32 @@ def run_once(
     ``timeout`` is seconds per solve, cancelled gracefully at a chunk
     boundary (``SolveTimeout``, exit code 4 in the CLI).
     """
+    if lanes < 1:
+        raise ValueError("lanes must be >= 1")
+    if lanes > 1 or engine in BATCHED_ENGINES:
+        if mode == "native":
+            raise ValueError(
+                "--lanes rides the JAX batched engines; the native host "
+                "runtime solves one problem at a time"
+            )
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "checkpointing persists the single-solve PCG carry; "
+                "drop --checkpoint-dir or --lanes"
+            )
+        if engine == "auto":
+            engine = "batched"
+        if engine not in BATCHED_ENGINES:
+            raise ValueError(
+                f"engine {engine!r} runs one solve per dispatch; "
+                "--lanes needs --engine batched or batched-pipelined"
+            )
+        if mode == "auto":
+            # lane batching is the single-chip throughput engine; the
+            # lane-sharded mesh composition is opt-in (--mode sharded /
+            # --mesh), not inferred from the device count
+            mode = "sharded" if mesh_shape is not None else "single"
+        lanes = max(lanes, 1)
     if mode == "native":
         if checkpoint_dir is not None:
             raise ValueError("checkpointing covers the JAX paths, not native")
@@ -274,6 +336,15 @@ def run_once(
                 "guarded/timeout runs are one wall-clocked chunked solve; "
                 "the repeat/batch timing protocol does not apply"
             )
+        if engine in BATCHED_ENGINES:
+            if mode == "sharded":
+                raise ValueError(
+                    "guarded batched solves run the single-device chunked "
+                    "lane driver (batch.driver); drop --mesh/--mode sharded"
+                )
+            return _run_batched_guarded(
+                problem, dtype, jdtype, engine, lanes, timeout=timeout,
+            )
         return _run_guarded(
             problem, mode, mesh_shape, dtype, jdtype, engine,
             timeout=timeout, max_recoveries=max_recoveries,
@@ -294,9 +365,24 @@ def run_once(
     requested_auto = engine == "auto"
     if mode == "single":
         with timer.phase("init"):
-            solver, args, engine = build_solver(problem, engine, jdtype)
+            solver, args, engine = build_solver(
+                problem, engine, jdtype, lanes=lanes
+            )
             fence(args)
         shape = (1, 1)
+    elif mode == "sharded" and engine in BATCHED_ENGINES:
+        from poisson_ellipse_tpu.parallel.batched_sharded import (
+            build_batched_sharded_solver,
+        )
+
+        with timer.phase("init"):
+            mesh = resolve_mesh(mesh_shape)
+            solver, args = build_batched_sharded_solver(
+                problem, mesh, lanes, jdtype,
+                pipelined=engine == "batched-pipelined",
+            )
+            fence(args)
+        shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
     elif mode == "sharded":
         if engine not in ("auto", "xla", "pallas", "fused", "pipelined"):
             raise ValueError(
@@ -369,7 +455,8 @@ def run_once(
     timer.add("solver", statistics.median(times))
 
     return _finish_report(
-        problem, shape, dtype, jdtype, engine, result, timer, times
+        problem, shape, dtype, jdtype, engine, result, timer, times,
+        lanes=lanes,
     )
 
 
@@ -455,6 +542,38 @@ def _run_guarded(
     return report
 
 
+def _run_batched_guarded(
+    problem: Problem,
+    dtype: str,
+    jdtype,
+    engine: str,
+    lanes: int,
+    timeout: float | None,
+) -> RunReport:
+    """One guarded lane-batched solve through the chunked lane driver
+    (``batch.driver.solve_batched``): per-chunk lane health, quarantine
+    events on the trace, graceful chunk-boundary timeout. Plain
+    wall-clock timing — the resilience stance of ``_run_guarded``."""
+    from poisson_ellipse_tpu.batch import solve_batched
+
+    timer = PhaseTimer()
+    with timer.phase("init"):
+        pass
+    t0 = time.perf_counter()
+    guarded = solve_batched(
+        problem, lanes, engine, jdtype, timeout=timeout,
+    )
+    fence(guarded.result)
+    t_solve = time.perf_counter() - t0
+    timer.add("solver", t_solve)
+    report = _finish_report(
+        problem, (1, 1), dtype, jdtype, engine, guarded.result, timer,
+        [t_solve], lanes=lanes,
+    )
+    report.recoveries = [event.kind for event in guarded.recoveries]
+    return report
+
+
 def _chain_solver(solver, args, n: int):
     """One jitted dispatch running n data-dependent solves.
 
@@ -473,7 +592,9 @@ def _chain_solver(solver, args, n: int):
 
         def one(_i, acc):
             res = solver(*a[:-1], r0 * (1.0 + tiny * acc))
-            return acc + res.diff.astype(acc.dtype)
+            # jnp.sum: a lane-batched result carries (B,) diffs — the
+            # perturbation only needs *a* data-dependent scalar
+            return acc + jnp.sum(res.diff).astype(acc.dtype)
 
         acc = lax.fori_loop(0, n - 1, one, jnp.zeros((), r0.dtype))
         return solver(*a[:-1], r0 * (1.0 + tiny * acc))
@@ -491,6 +612,8 @@ def _finish_report(
     timer: PhaseTimer,
     times: list[float],
     timed_iters: int | None = None,
+    lanes: int = 1,
+    quarantined: int = 0,
 ) -> RunReport:
     """Shared report tail: L2-vs-analytic, roofline, RunReport assembly.
 
@@ -498,19 +621,40 @@ def _finish_report(
     differs from the cumulative count (resumed checkpointed runs); the
     roofline is computed over it, and it is suppressed entirely for a
     resume that had nothing left to run.
+
+    A lane-batched ``result`` (BatchedPCGResult) is reduced to the
+    report's scalars — worst-lane iters/diff, all-lanes converged,
+    lane-0 L2 — plus the aggregate solves/sec; the single-solve HBM
+    roofline does not apply to it.
     """
+    solves_per_sec = None
+    if hasattr(result, "quarantined"):  # a per-lane BatchedPCGResult
+        quarantined = int(jnp.sum(result.quarantined))
+        iters = int(jnp.max(result.iters))
+        converged = bool(jnp.all(result.converged))
+        breakdown = bool(jnp.any(result.breakdown))
+        diff = float(jnp.max(result.diff))
+        w0 = result.w[0]
+        if timer.totals["solver"] > 0:
+            solves_per_sec = lanes / timer.totals["solver"]
+    else:
+        iters = int(result.iters)
+        converged = bool(result.converged)
+        breakdown = bool(result.breakdown)
+        diff = float(result.diff)
+        w0 = result.w
     with timer.phase("finalize"):
-        l2 = float(l2_error_vs_analytic(problem, result.w))
+        l2 = float(l2_error_vs_analytic(problem, w0))
 
     from poisson_ellipse_tpu.harness.roofline import roofline
 
-    n = timed_iters if timed_iters is not None else int(result.iters)
+    n = timed_iters if timed_iters is not None else iters
     roof = (
         roofline(
             problem, engine, n, timer.totals["solver"], jdtype,
             n_devices=shape[0] * shape[1],
         )
-        if n > 0
+        if n > 0 and lanes == 1 and engine not in BATCHED_ENGINES
         else {"passes_per_iter": 0.0, "hbm_gbps": 0.0, "hbm_peak_frac": None}
     )
     return RunReport(
@@ -518,15 +662,18 @@ def _finish_report(
         mesh_shape=shape,
         dtype=dtype,
         engine=engine,
-        iters=int(result.iters),
-        converged=bool(result.converged),
-        breakdown=bool(result.breakdown),
-        diff=float(result.diff),
+        iters=iters,
+        converged=converged,
+        breakdown=breakdown,
+        diff=diff,
         l2_error=l2,
         t_init=timer.totals["init"],
         t_solver=timer.totals["solver"],
         times=times,
         timed_iters=timed_iters,
+        lanes=lanes,
+        solves_per_sec=solves_per_sec,
+        quarantined=quarantined,
         **roof,
     )
 

@@ -8,7 +8,7 @@ caught by hand across five rewrites. tpulint catches them mechanically:
     python -m poisson_ellipse_tpu.lint              # paths from pyproject
     python -m poisson_ellipse_tpu.lint poisson_ellipse_tpu/ops --statistics
 
-Rules are TPU001–TPU009 (see :mod:`.rules`); any finding can be waived
+Rules are TPU001–TPU010 (see :mod:`.rules`); any finding can be waived
 in place with a trailing or preceding-line comment::
 
     x = jnp.zeros(n, jnp.float64)  # tpulint: disable=TPU001
@@ -153,6 +153,9 @@ def load_config(root: Optional[str] = None) -> LintConfig:
         ),
         reraise_fns=tuple(
             table.get("reraise-fns", cfg.reraise_fns)
+        ),
+        aot_warmup_fns=tuple(
+            table.get("aot-warmup-fns", cfg.aot_warmup_fns)
         ),
     )
 

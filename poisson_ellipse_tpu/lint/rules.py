@@ -24,6 +24,10 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | re-raises nor hands off to a configured       |
 |        |                    | classify-and-re-raise helper — device-runtime |
 |        |                    | errors silently eaten                         |
+| TPU010 | recompile-hazard   | `.lower().compile()` AOT chains inside Python |
+|        |                    | loop bodies, and calls of static-argnum jitted|
+|        |                    | callables whose static argument varies with a |
+|        |                    | loop — a fresh trace+compile per iteration    |
 """
 
 from __future__ import annotations
@@ -76,6 +80,11 @@ class LintConfig:
     # on the caller's behalf, so the handler body carries no literal
     # `raise` of its own.
     reraise_fns: tuple[str, ...] = ()
+    # TPU010: functions matching these names are deliberate AOT warm-up
+    # sites (cache fills, capacity probes) — a lower().compile() chain
+    # in a loop there is the *fix* for recompile hazards, not one.
+    # jit_factory_patterns are exempt as well (build-once contract).
+    aot_warmup_fns: tuple[str, ...] = ("warmup*", "precompile*")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -945,6 +954,182 @@ def _handler_reraises(module: Module, handler: ast.ExceptHandler,
                 return True
         stack.extend(ast.iter_child_nodes(node))
     return False
+
+
+# --------------------------------------------------------------------------
+# TPU010 — recompilation hazards: AOT chains in loops, loop-varying statics
+# --------------------------------------------------------------------------
+
+
+def _is_lower_compile_chain(node: ast.Call) -> bool:
+    """``<expr>.lower(...).compile(...)`` — the AOT compile chain."""
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == "compile"
+        and isinstance(f.value, ast.Call)
+        and isinstance(f.value.func, ast.Attribute)
+        and f.value.func.attr == "lower"
+    )
+
+
+def _in_python_loop(module: Module, node: ast.AST) -> bool:
+    return any(
+        isinstance(anc, (ast.For, ast.While, ast.AsyncFor))
+        for anc in module.ancestors(node)
+    )
+
+
+def _enclosing_is_exempt(module: Module, node: ast.AST,
+                         config: LintConfig) -> bool:
+    """Deliberate-AOT carve-out: warm-up fns and jit factories may
+    compile in loops — that IS the warm pool being filled once."""
+    enclosing = module.enclosing_function(node)
+    if enclosing is None:
+        return False
+    name = getattr(enclosing, "name", "<lambda>")
+    patterns = config.aot_warmup_fns + config.jit_factory_patterns
+    return any(fnmatch.fnmatch(name, pat) for pat in patterns)
+
+
+def _static_jit_bindings(module: Module):
+    """name → (static positional indices, static keyword names) for every
+    ``f = jax.jit(g, static_argnums=…/static_argnames=…)`` binding whose
+    static spec is a literal. Non-literal specs stay silent (the rule's
+    conservative stance)."""
+    out: dict[str, tuple[frozenset[int], frozenset[str]]] = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call) or module.jit_construction(call) is None:
+            continue
+        nums: set[int] = set()
+        names: set[str] = set()
+        literal = True
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                lit = Module._literal_int_tuple(kw.value)
+                if lit is None and isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, int):
+                    lit = (kw.value.value,)
+                if lit is None:
+                    literal = False
+                    break
+                nums.update(lit)
+            elif kw.arg == "static_argnames":
+                vals = (
+                    kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value]
+                )
+                if not all(
+                    isinstance(v, ast.Constant) and isinstance(v.value, str)
+                    for v in vals
+                ):
+                    literal = False
+                    break
+                names.update(v.value for v in vals)
+        if not literal or not (nums or names):
+            continue
+        out[target.id] = (frozenset(nums), frozenset(names))
+    return out
+
+
+def _loop_targets(loop: ast.AST) -> set[str]:
+    """Names a loop rebinds per iteration: ``for`` targets, plus names
+    assigned anywhere in a ``while`` body (over-approximate)."""
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        return {
+            n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)
+        }
+    names: set[str] = set()
+    for stmt in loop.body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                names.add(n.id)
+    return names
+
+
+@rule(
+    "TPU010",
+    "recompile-hazard",
+    "`.lower().compile()` inside a Python loop body, or a static-argnum "
+    "jitted call whose static argument varies with the loop — a fresh "
+    "trace+compile per iteration/request",
+)
+def check_recompile_hazard(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """The serving-path cold-start hazard, fenced structurally.
+
+    Two prongs (TPU006 owns the third recompile shape — ``jax.jit``
+    *construction* in loops/per-call closures — so it is not repeated
+    here):
+
+    - *AOT chains in loops*: ``f.lower(args).compile()`` inside a Python
+      ``for``/``while`` compiles a fresh executable every iteration —
+      per-request latency in the hundreds of ms to minutes. Deliberate
+      warm-up sites (a pool being filled once, a capacity probe walking
+      an engine ladder) live in functions named per ``aot-warmup-fns`` /
+      ``jit-factory-patterns`` and stay silent; everything else should
+      bucket its shapes (``runtime.compile_cache``) or hoist.
+    - *Loop-varying statics*: calling a ``jax.jit(g, static_argnums=…)``
+      binding with a static-position argument that mentions a name the
+      loop rebinds keys the trace cache on a fresh Python value per
+      iteration — every call retraces and recompiles. Pass the value as
+      a traced operand (the solvers' traced ``limit`` bound is the house
+      pattern), or hoist the call.
+    """
+    statics = _static_jit_bindings(module)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_lower_compile_chain(node):
+            if _in_python_loop(module, node) and not _enclosing_is_exempt(
+                module, node, config
+            ):
+                yield _finding(
+                    module,
+                    node,
+                    "TPU010",
+                    ".lower().compile() inside a Python loop: a fresh "
+                    "XLA compile every iteration — bucket the shapes and "
+                    "AOT once (runtime.compile_cache), hoist the compile, "
+                    "or move it into a warm-up function (aot-warmup-fns) "
+                    "if this loop IS the one-time pool fill",
+                )
+            continue
+        if not (isinstance(node.func, ast.Name) and node.func.id in statics):
+            continue
+        nums, names = statics[node.func.id]
+        for loop in module.ancestors(node):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            varying = _loop_targets(loop)
+            hot_args = [
+                arg
+                for i, arg in enumerate(node.args)
+                if i in nums and module.expr_mentions(arg, varying)
+            ] + [
+                kw.value
+                for kw in node.keywords
+                if kw.arg in names
+                and module.expr_mentions(kw.value, varying)
+            ]
+            if hot_args:
+                yield _finding(
+                    module,
+                    hot_args[0],
+                    "TPU010",
+                    f"static argument of jitted `{node.func.id}` varies "
+                    "with the enclosing loop: the dispatch cache keys on "
+                    "its Python value, so every iteration retraces and "
+                    "recompiles — pass it as a traced operand (the "
+                    "solvers' traced `limit` pattern) or hoist the call",
+                )
+                break
 
 
 @rule(

@@ -336,6 +336,223 @@ def apply_a_dots_pallas(w, a, b, h1, h2, pairs, interpret=None):
     return jnp.pad(out, 1), sums
 
 
+def _batched_stencil_kernel(h1, h2, tm, bn, w_hbm, a_hbm, b_hbm, out_ref,
+                            w_s, a_s, b_s, sems):
+    """One (lane, TM-row) tile of the batched 5-point stencil.
+
+    The lane dimension rides the FIRST grid axis: grid=(B, n_tiles), so
+    each program DMAs its lane's aligned (TM+8)-row window of ``w`` and
+    the lane-shared coefficient windows. Coefficient windows depend only
+    on the row tile, so their DMA re-fetches per lane are VMEM-friendly
+    re-reads of the same HBM lines (the shared-geometry serving layout).
+    """
+    lane = pl.program_id(0)
+    r0 = pl.program_id(1) * tm
+    copies = [
+        pltpu.make_async_copy(
+            w_hbm.at[lane, pl.ds(r0, tm + 8), :], w_s, sems.at[0]
+        ),
+        pltpu.make_async_copy(a_hbm.at[pl.ds(r0, tm + 8), :], a_s, sems.at[1]),
+        pltpu.make_async_copy(b_hbm.at[pl.ds(r0, tm + 8), :], b_s, sems.at[2]),
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
+
+    # expression tree mirrors ops.stencil.apply_a_block term for term
+    wc = w_s[1 : tm + 1, 1 : bn + 1]
+    ax = -(
+        a_s[2 : tm + 2, 1 : bn + 1] * (w_s[2 : tm + 2, 1 : bn + 1] - wc) / h1
+        - a_s[1 : tm + 1, 1 : bn + 1] * (wc - w_s[0:tm, 1 : bn + 1]) / h1
+    ) / h1
+    ay = -(
+        b_s[1 : tm + 1, 2 : bn + 2] * (w_s[1 : tm + 1, 2 : bn + 2] - wc) / h2
+        - b_s[1 : tm + 1, 1 : bn + 1] * (wc - w_s[1 : tm + 1, 0:bn]) / h2
+    ) / h2
+    out_ref[0] = ax + ay
+
+
+def _batched_tiling(w):
+    """(tm, k, cols, pads) for a (B, bm+2, bn+2) batched operand — the
+    ``apply_a_block_pallas`` alignment contract per lane."""
+    bm = w.shape[1] - 2
+    bn = w.shape[2] - 2
+    n_tiles = -(-bm // TILE_ROWS)
+    tm = round_up(-(-bm // n_tiles), 8)
+    k = round_up(bm, tm)
+    cols = round_up(bn + 2, 128)
+    return bm, bn, tm, k, cols
+
+
+def apply_a_batched_block_pallas(w, a_ext, b_ext, h1, h2, interpret=None):
+    """A·w per lane over halo-extended blocks: (B, bm+2, bn+2) iterate,
+    (bm+2, bn+2) lane-shared coefficients → (B, bm, bn).
+
+    The batched twin of ``apply_a_block_pallas`` with the lane dimension
+    mapped onto the Pallas grid — grid=(B, row_tiles) — so one kernel
+    launch covers the whole batch instead of B launches (per-launch
+    overhead is exactly what lane batching amortises).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    B = w.shape[0]
+    bm, bn, tm, k, cols = _batched_tiling(w)
+    pad2 = ((0, k + 8 - (bm + 2)), (0, cols - (bn + 2)))
+    w_p = jnp.pad(w, ((0, 0),) + pad2)
+    a_p = jnp.pad(a_ext, pad2)
+    b_p = jnp.pad(b_ext, pad2)
+    dtype = w.dtype
+    kernel = functools.partial(
+        _batched_stencil_kernel, float(h1), float(h2), tm, bn
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, k // tm),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=pl.BlockSpec(
+            (1, tm, bn), lambda l, i: (l, i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, k, bn), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=interpret,
+    )(w_p, a_p, b_p)
+    return out[:, :bm]
+
+
+def apply_a_batched_pallas(w, a, b, h1, h2, interpret=None):
+    """A·w per lane on full (B, M+1, N+1) node grids (zero boundary
+    ring), lane-shared (M+1, N+1) coefficients — the batched twin of
+    ``apply_a_pallas``."""
+    return jnp.pad(
+        apply_a_batched_block_pallas(w, a, b, h1, h2, interpret=interpret),
+        ((0, 0), (1, 1), (1, 1)),
+    )
+
+
+def _batched_stencil_dots_kernel(h1, h2, tm, bn, n_pairs, n_tiles, *refs):
+    """One (lane, TM-row) tile of the fused batched stencil + per-lane
+    dot partials. Ref layout follows ``_stencil_dots_kernel`` with the
+    lane on grid axis 0 and a per-lane column in the (n_pairs, B) SMEM
+    sums output; the TPU grid's sequential execution walks lane-major,
+    so the accumulator finishes lane l before lane l+1 begins.
+    """
+    w_hbm, a_hbm, b_hbm = refs[0:3]
+    pair_refs = refs[3 : 3 + 2 * n_pairs]
+    out_ref, sums_out = refs[3 + 2 * n_pairs : 5 + 2 * n_pairs]
+    w_s, a_s, b_s, sems, acc = refs[5 + 2 * n_pairs :]
+
+    lane = pl.program_id(0)
+    i = pl.program_id(1)
+    r0 = i * tm
+    copies = [
+        pltpu.make_async_copy(
+            w_hbm.at[lane, pl.ds(r0, tm + 8), :], w_s, sems.at[0]
+        ),
+        pltpu.make_async_copy(a_hbm.at[pl.ds(r0, tm + 8), :], a_s, sems.at[1]),
+        pltpu.make_async_copy(b_hbm.at[pl.ds(r0, tm + 8), :], b_s, sems.at[2]),
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
+
+    wc = w_s[1 : tm + 1, 1 : bn + 1]
+    ax = -(
+        a_s[2 : tm + 2, 1 : bn + 1] * (w_s[2 : tm + 2, 1 : bn + 1] - wc) / h1
+        - a_s[1 : tm + 1, 1 : bn + 1] * (wc - w_s[0:tm, 1 : bn + 1]) / h1
+    ) / h1
+    ay = -(
+        b_s[1 : tm + 1, 2 : bn + 2] * (w_s[1 : tm + 1, 2 : bn + 2] - wc) / h2
+        - b_s[1 : tm + 1, 1 : bn + 1] * (wc - w_s[1 : tm + 1, 0:bn]) / h2
+    ) / h2
+    out_ref[0] = ax + ay
+
+    @pl.when(i == 0)
+    def _():
+        for j in range(n_pairs):
+            acc[j] = jnp.zeros((), wc.dtype)
+
+    for j in range(n_pairs):
+        acc[j] += jnp.sum(pair_refs[2 * j][0] * pair_refs[2 * j + 1][0])
+
+    @pl.when(i == n_tiles - 1)
+    def _():
+        for j in range(n_pairs):
+            sums_out[j, lane] = acc[j]
+
+
+def apply_a_dots_batched_pallas(w, a, b, h1, h2, pairs, interpret=None):
+    """Per-lane A·w PLUS per-lane dot partials, one fused VMEM pass.
+
+    ``w`` is (B, M+1, N+1); ``a``/``b`` lane-shared (M+1, N+1);
+    ``pairs`` a sequence of ((B, M+1, N+1), (B, M+1, N+1)) operand
+    pairs. Returns ``(Aw, sums)`` with ``Aw`` (B, M+1, N+1) (zero ring)
+    and ``sums`` (n_pairs, B) raw per-lane Σ xⱼ·yⱼ — exactly the
+    stacked (k, B) bundle of ``batch.batched_pcg.lane_dots``, produced
+    while each lane's stencil tile is in flight. The batched pipelined
+    engine's whole (8, B) bundle rides this single kernel launch.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    pairs = tuple(pairs)
+    n_pairs = len(pairs)
+    if n_pairs == 0:
+        raise ValueError("need at least one (x, y) dot pair")
+    B = w.shape[0]
+    bm, bn, tm, k, cols = _batched_tiling(w)
+    pad2 = ((0, k + 8 - (bm + 2)), (0, cols - (bn + 2)))
+    w_p = jnp.pad(w, ((0, 0),) + pad2)
+    a_p = jnp.pad(a, pad2)
+    b_p = jnp.pad(b, pad2)
+    # dot operands enter cropped to the (bm, bn) interior tile shape and
+    # zero-row-padded to the tile multiple (zero rows add nothing)
+    flat = []
+    for x, y in pairs:
+        flat += [
+            jnp.pad(x[:, 1:-1, 1:-1], ((0, 0), (0, k - bm), (0, 0))),
+            jnp.pad(y[:, 1:-1, 1:-1], ((0, 0), (0, k - bm), (0, 0))),
+        ]
+    dtype = w.dtype
+    blk = lambda: pl.BlockSpec(
+        (1, tm, bn), lambda l, i: (l, i, 0), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _batched_stencil_dots_kernel, float(h1), float(h2), tm, bn,
+        n_pairs, k // tm,
+    )
+    out, sums = pl.pallas_call(
+        kernel,
+        grid=(B, k // tm),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3
+        + [blk() for _ in range(2 * n_pairs)],
+        out_specs=(
+            pl.BlockSpec(
+                (1, tm, bn), lambda l, i: (l, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, k, bn), dtype),
+            jax.ShapeDtypeStruct((n_pairs, B), dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.VMEM((tm + 8, cols), dtype),
+            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.SMEM((n_pairs,), dtype),
+        ],
+        interpret=interpret,
+    )(w_p, a_p, b_p, *flat)
+    return jnp.pad(out[:, :bm], ((0, 0), (1, 1), (1, 1))), sums
+
+
 def _dinv_kernel(r_ref, d_ref, out_ref):
     d = d_ref[:]
     safe = jnp.where(d != 0.0, d, 1.0)

@@ -66,6 +66,10 @@ class Fault:
     ``field`` names the carry field to corrupt (engine-adapter field
     names: classical ``w/r/p/zr``, pipelined ``x/r/u/w/z/s/p/gamma``);
     defaults per kind. ``rows`` is the slab height for ``halo``.
+    ``lane`` addresses one lane of a batched carry (``batch.driver``) —
+    the corruption lands on that lane's slice only, so the quarantine
+    path is exercised against a batch whose other lanes stay healthy;
+    ``None`` (single-solve carries) corrupts the whole field.
     ``fired`` makes every fault one-shot — a replayed chunk after a
     recovery re-runs clean, which is what makes transient-fault recovery
     hit exact oracle parity. ``persistent=True`` re-fires on every visit
@@ -78,6 +82,7 @@ class Fault:
     at_iter: int = 0
     field: str | None = None
     rows: int = 1
+    lane: int | None = None
     fired: bool = False
     persistent: bool = False
 
@@ -90,9 +95,11 @@ class Fault:
             raise ValueError("at_iter must be >= 0")
 
 
-def inject_nan(at_iter: int, field: str = "r") -> Fault:
-    """NaN-poison carry field ``field`` at iteration ``at_iter``."""
-    return Fault("nan", at_iter=at_iter, field=field)
+def inject_nan(at_iter: int, field: str = "r",
+               lane: int | None = None) -> Fault:
+    """NaN-poison carry field ``field`` at iteration ``at_iter`` —
+    optionally only lane ``lane`` of a batched carry."""
+    return Fault("nan", at_iter=at_iter, field=field, lane=lane)
 
 
 def force_breakdown(at_iter: int) -> Fault:
@@ -156,6 +163,8 @@ class FaultPlan:
 def _corrupt(state, fault: Fault, fields: dict[str, int],
              breakdown_index: int, zr_index: int):
     state = list(state)
+    if fault.lane is not None:
+        return _corrupt_lane(state, fault, fields, breakdown_index, zr_index)
     if fault.kind == "breakdown":
         state[breakdown_index] = jnp.asarray(True)
     elif fault.kind == "stagnation":
@@ -185,6 +194,38 @@ def _corrupt(state, fault: Fault, fields: dict[str, int],
             state[idx] = jnp.full_like(arr, jnp.nan)
         else:
             state[idx] = arr.at[: fault.rows].set(jnp.nan)
+    return tuple(state)
+
+
+def _corrupt_lane(state, fault: Fault, fields: dict[str, int],
+                  breakdown_index: int, zr_index: int):
+    """Lane-addressed corruption of a batched carry: only slice
+    ``fault.lane`` of the named field/flag is touched, so the rest of
+    the batch runs clean past the fault (the quarantine contract)."""
+    lane = fault.lane
+    if fault.kind == "breakdown":
+        flags = state[breakdown_index]
+        state[breakdown_index] = flags.at[lane].set(True)
+    elif fault.kind == "stagnation":
+        zr = state[zr_index]
+        state[zr_index] = zr.at[lane].set(jnp.asarray(1e30, zr.dtype))
+    elif fault.kind in ("nan", "halo"):
+        field = fault.field or "r"
+        if field not in fields:
+            raise ValueError(
+                f"engine carry has no field {field!r} (has {sorted(fields)})"
+            )
+        idx = fields[field]
+        arr = state[idx]
+        if fault.kind == "halo" and arr.ndim < 3:
+            raise ValueError(
+                f"field {field!r} is not a lane-stacked grid; halo "
+                "faults need a (B, g1, g2) carry field"
+            )
+        if fault.kind == "nan":
+            state[idx] = arr.at[lane].set(jnp.nan)
+        else:
+            state[idx] = arr.at[lane, : fault.rows].set(jnp.nan)
     return tuple(state)
 
 

@@ -498,6 +498,12 @@ def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret):
         return _PipelinedAdapter(
             problem, dtype, stencil="pallas", interpret=interpret
         )
+    if engine in ("batched", "batched-pipelined"):
+        raise ValueError(
+            f"engine {engine!r} has its own chunked guard — the lane "
+            "driver (batch.driver.solve_batched) quarantines poisoned "
+            "lanes per chunk instead of walking the single-solve ladder"
+        )
     raise ValueError(f"no chunked adapter for engine {engine!r}")
 
 

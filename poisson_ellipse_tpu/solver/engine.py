@@ -23,6 +23,11 @@ best path by default:
   pipelined-   pipelined recurrence driving  ~1.0x     (f32/bf16; the
   pallas       the fused stencil+partials              one-VMEM-pass form
                Pallas kernel                           of the same loop)
+  batched      B independent lanes in ONE    per-lane  (lanes= selects B;
+               fused while_loop, per-lane    cost      the throughput
+               masked updates + quarantine   amortised engine — batch.*)
+  batched-     the same lanes through the    as above  (one stacked (8,B)
+  pipelined    pipelined recurrence                    dot bundle/iter)
 
 Policy (``select_engine``): resident if the whole working set fits VMEM;
 else streamed if the state fits; else xl. f64 always takes xla — the
@@ -62,8 +67,12 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 
 ENGINES = (
     "auto", "xla", "fused", "resident", "streamed", "xl", "pallas",
-    "pipelined", "pipelined-pallas",
+    "pipelined", "pipelined-pallas", "batched", "batched-pipelined",
 )
+
+# the lane-batched throughput engines (batch.*): one dispatch runs
+# ``lanes`` independent solves; results are per-lane (BatchedPCGResult)
+BATCHED_ENGINES = ("batched", "batched-pipelined")
 
 
 def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
@@ -93,9 +102,16 @@ def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
 
 def build_solver(
     problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
-    history: bool = False,
+    history: bool = False, lanes: int = 1,
 ):
     """(jitted solver, args, resolved_engine) for a single-chip solve.
+
+    ``lanes`` selects the batch width of the lane-batched engines
+    (``batched`` / ``batched-pipelined``): their solver runs ``lanes``
+    independent problems per dispatch — args end with a lane-stacked
+    RHS — and returns a per-lane :class:`~poisson_ellipse_tpu.batch.
+    BatchedPCGResult` instead of a ``PCGResult``. Every other engine
+    requires ``lanes == 1``.
 
     All engines share the PCGResult contract and the f64-host-assembled,
     rounded-once operand fidelity, so swapping engines changes speed, not
@@ -117,6 +133,39 @@ def build_solver(
     cannot fail this way) instead of surfacing an opaque compile error.
     Explicitly requested engines still fail loudly.
     """
+    if lanes != 1 and engine not in BATCHED_ENGINES:
+        raise ValueError(
+            f"engine {engine!r} runs one solve per dispatch; lanes={lanes} "
+            "needs the lane-batched engines ('batched' / "
+            "'batched-pipelined')"
+        )
+    if engine in BATCHED_ENGINES:
+        if history:
+            raise ValueError(
+                "the batched engines carry per-lane scalar recurrences, "
+                "not the obs.convergence ring buffers; use a single-lane "
+                "engine for history=True"
+            )
+        if lanes < 1:
+            raise ValueError("lanes must be >= 1")
+        import jax
+
+        from poisson_ellipse_tpu.batch import (
+            batched_operands,
+            pcg_batched,
+            pcg_batched_pipelined,
+        )
+
+        run = (
+            pcg_batched if engine == "batched" else pcg_batched_pipelined
+        )
+        args = batched_operands(problem, lanes, dtype)
+        # no donation: the build-once-call-many contract re-feeds these
+        # operands on every dispatch (the timing protocols re-dispatch)
+        solver = jax.jit(  # tpulint: disable=TPU004
+            lambda a, b, rhs: run(problem, a, b, rhs)
+        )
+        return solver, args, engine
     if engine == "auto" and history:
         # the mega-kernel engines auto would pick cannot record: take the
         # reference-trajectory engine instead of failing a telemetry ask
@@ -217,14 +266,17 @@ def build_solver(
 
 def solve(
     problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
-    history: bool = False,
+    history: bool = False, lanes: int = 1,
 ):
     """Assemble and solve single-chip with the selected engine.
 
     ``history=True`` returns ``(PCGResult, obs.ConvergenceTrace)`` — the
     on-device per-iteration convergence telemetry (see ``build_solver``).
+    ``lanes`` selects the batch width of the batched engines, whose
+    result is per-lane (see ``build_solver``).
     """
     solver, args, _ = build_solver(
-        problem, engine, dtype, interpret=interpret, history=history
+        problem, engine, dtype, interpret=interpret, history=history,
+        lanes=lanes,
     )
     return solver(*args)

@@ -27,8 +27,8 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_nine_rules():
-    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)]
+def test_registry_has_all_ten_rules():
+    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + ["TPU010"]
     for code, rule in RULES.items():
         assert rule.code == code
         assert rule.name and rule.summary
@@ -805,6 +805,148 @@ def test_tpu009_pyproject_reraise_fns_loaded():
     # recovery paths carry literal raises)
     config = load_config()
     assert isinstance(config.reraise_fns, tuple)
+
+
+# -- TPU010: recompile hazards ----------------------------------------------
+
+
+def test_tpu010_positive_lower_compile_in_loop():
+    src = """
+        import jax
+
+        def serve(requests, fn):
+            out = []
+            for req in requests:
+                exe = jax.jit(fn).lower(req).compile()
+                out.append(exe(req))
+            return out
+    """
+    # jax.jit inside the loop is TPU006's finding; the AOT chain is ours
+    codes = codes_of(src)
+    assert "TPU010" in codes and "TPU006" in codes
+
+
+def test_tpu010_negative_warmup_and_factory_fns_exempt():
+    # a warm pool filling its buckets once, and a build_* factory
+    # probing a capacity ladder, are the deliberate AOT sites
+    src = """
+        def warmup_buckets(jitted, buckets):
+            pool = {}
+            for shape in buckets:
+                pool[shape] = jitted.lower(shape).compile()
+            return pool
+
+        def build_solver(chain, jitted, args):
+            for cand in chain:
+                jitted.lower(*args).compile()
+            return jitted
+    """
+    assert codes_of(src) == []
+    # the knob is configurable: renaming the exempt pattern re-arms it
+    assert "TPU010" in codes_of(
+        src, aot_warmup_fns=("somethingelse*",),
+        jit_factory_patterns=("nope*",),
+    )
+
+
+def test_tpu010_negative_single_shot_aot_outside_loops():
+    src = """
+        import jax
+
+        def precompile(fn, shape):
+            return jax.jit(fn).lower(shape).compile()
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu010_positive_loop_varying_static_arg():
+    src = """
+        import jax
+
+        step = jax.jit(run_chunk, static_argnums=(1,))
+
+        def drive(state, chunks):
+            for limit in chunks:
+                state = step(state, limit)
+            return state
+    """
+    assert codes_of(src) == ["TPU010"]
+
+
+def test_tpu010_positive_loop_varying_static_argname():
+    src = """
+        import jax
+
+        step = jax.jit(run_chunk, static_argnames=("limit",))
+
+        def drive(state, chunks):
+            k = 0
+            while k < 10:
+                k = k + 1
+                state = step(state, limit=k)
+            return state
+    """
+    assert codes_of(src) == ["TPU010"]
+
+
+def test_tpu010_negative_traced_and_loop_invariant_statics():
+    # the house pattern: the bound rides as a TRACED operand (position 1
+    # is not static), and a static that does not vary with the loop is
+    # one compile, not one per iteration
+    src = """
+        import jax
+
+        step = jax.jit(run_chunk)
+        shaped = jax.jit(run_chunk, static_argnums=(1,))
+
+        def drive(state, chunks, bucket):
+            for limit in chunks:
+                state = step(state, limit)
+                state = shaped(state, bucket)
+            return state
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu010_negative_nonliteral_static_spec_stays_silent():
+    src = """
+        import jax
+
+        step = jax.jit(run_chunk, static_argnums=SPEC)
+
+        def drive(state, chunks):
+            for limit in chunks:
+                state = step(state, limit)
+            return state
+    """
+    assert codes_of(src) == []
+    # a non-literal argnames keyword must not crash the pass when a
+    # literal argnums follows it in the same jit call — the binding is
+    # simply not trusted (conservative silence, not an AttributeError)
+    mixed = """
+        import jax
+
+        step = jax.jit(run_chunk, static_argnames=(NAME,), static_argnums=(1,))
+
+        def drive(state, chunks):
+            for limit in chunks:
+                state = step(state, limit)
+            return state
+    """
+    assert codes_of(mixed) == []
+
+
+def test_tpu010_suppression_and_pyproject_knob():
+    src = """
+        def refresh(jitted, shapes):
+            for s in shapes:
+                jitted.lower(s).compile()  # tpulint: disable=TPU010 — drill
+    """
+    assert codes_of(src) == []
+    from poisson_ellipse_tpu.lint import load_config
+
+    config = load_config()
+    assert "warmup*" in config.aot_warmup_fns
 
 
 def test_suppression_is_per_code_not_blanket():

@@ -151,6 +151,57 @@ def test_recovery_field_absent_or_failed_is_supported(workspace):
     assert "Resilience drill" not in readme.read_text()
 
 
+def test_throughput_and_coldstart_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        throughput=[
+            {"grid": [100, 200], "lanes": 1, "engine": "batched",
+             "t_batch_s": 0.5, "solves_per_sec": 2.0,
+             "speedup_vs_1lane": 1.0, "iters": 42, "converged": True},
+            {"grid": [100, 200], "lanes": 8, "engine": "batched",
+             "t_batch_s": 1.0, "solves_per_sec": 8.0,
+             "speedup_vs_1lane": 4.0, "iters": 42, "converged": True},
+        ],
+        coldstart={
+            "grid": [100, 200], "engine": "batched", "lanes": 8,
+            "t_compile_s": 2.5, "t_solve_s": 0.5,
+            "t_pool_cold_s": 2.4, "t_pool_warm_s": 0.0002,
+            "pool_hit": True,
+        },
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Serving throughput" in text
+    assert "| 100×200 | 8 | 1.00 s | 8 | **4×** |" in text
+    assert "Cold-start split (100×200, lanes=8)" in text
+    assert "compile 2.50 s vs solve 0.5000 s" in text
+    assert "cache HIT returning the same executable (0.20 ms)" in text
+
+
+def test_throughput_absent_or_failed_is_supported(workspace):
+    # pre-batch artifacts lack the keys; a failed throughput row (no
+    # solves_per_sec — the run aborted) is skipped, a missed warm pool
+    # renders as the regression it is
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Serving throughput" not in text
+    assert "Cold-start split" not in text
+    artifact.write_text(json.dumps(make_artifact(
+        throughput=[{"grid": [100, 200], "lanes": 8, "engine": "batched",
+                     "converged": False}],
+        coldstart={"grid": [100, 200], "engine": "batched", "lanes": 8,
+                   "t_compile_s": 2.5, "t_solve_s": 0.5,
+                   "t_pool_cold_s": 2.4, "t_pool_warm_s": 2.3,
+                   "pool_hit": False},
+    )))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Serving throughput" not in text  # no renderable rows
+    assert "MISSED the warm pool (regression)" in text
+
+
 README_STUB = """# stub
 
 <!-- bench:headline -->

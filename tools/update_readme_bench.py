@@ -195,7 +195,61 @@ def table_block(rec: dict, src: str) -> str:
     obs = observability_lines(rec)
     if obs:
         lines += [""] + obs
+    serving = serving_lines(rec)
+    if serving:
+        lines += [""] + serving
     return "\n".join(lines)
+
+
+def serving_lines(rec: dict) -> list[str]:
+    """Markdown for the artifact's serving keys (``throughput`` /
+    ``coldstart``, emitted by bench.py since the batch layer landed).
+    Pre-batch artifacts lack the keys and render without these lines;
+    a failed/partial row (no solves_per_sec) is skipped, not a crash."""
+    lines: list[str] = []
+    thr = rec.get("throughput")
+    rows = [
+        r for r in (thr or [])
+        if r.get("solves_per_sec") is not None and r.get("grid")
+    ]
+    if rows:
+        lines += [
+            "Serving throughput (`--lanes`, batched engine, marginal-cost "
+            "protocol — aggregate solves/sec per dispatch):",
+            "",
+            "| Grid | lanes | T_batch | solves/sec | vs 1 lane |",
+            "|---|---|---|---|---|",
+        ]
+        for r in rows:
+            M, N = r["grid"]
+            t = (
+                fmt_t(r["t_batch_s"]) if r.get("t_batch_s") is not None
+                else "—"
+            )
+            vs = (
+                f"**{r['speedup_vs_1lane']:g}×**"
+                if r.get("speedup_vs_1lane") else "—"
+            )
+            lines.append(
+                f"| {M}×{N} | {r['lanes']} | {t} | "
+                f"{r['solves_per_sec']:g} | {vs} |"
+            )
+    cold = rec.get("coldstart")
+    if cold and cold.get("t_compile_s") is not None:
+        M, N = cold["grid"]
+        hit = (
+            "the re-request was a cache HIT returning the same executable"
+            f" ({cold['t_pool_warm_s'] * 1e3:.2f} ms)"
+            if cold.get("pool_hit")
+            else "the re-request MISSED the warm pool (regression)"
+        )
+        lines.append(
+            f"Cold-start split ({M}×{N}, lanes={cold.get('lanes', '?')}): "
+            f"compile {fmt_t(cold['t_compile_s'])} vs solve "
+            f"{fmt_t(cold['t_solve_s'])}; with the AOT warm pool "
+            f"(`runtime.compile_cache`), {hit}."
+        )
+    return lines
 
 
 def observability_lines(rec: dict) -> list[str]:
