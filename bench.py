@@ -23,6 +23,10 @@ checks):
                 fictitious-domain stiffness result asserted: iteration
                 counts stay FLAT as ε shrinks (the Jacobi preconditioner
                 absorbs the 1/ε stiffness — see ``bench_eps_sweep``).
+  spectrum    — κ(M⁻¹A) + predicted-vs-actual iterations per published
+                grid from the Lanczos-of-CG reconstruction
+                (``obs.spectrum``) -> "spectrum" key; κ is regression-
+                gated between rounds by ``tools/bench_compare.py``.
   serving     — "throughput" key: aggregate solves/sec with the batched
                 engine at lanes ∈ {1, 8, 32} on 400×600 and the headline
                 grid (marginal-cost protocol; lane-0 oracle equality) and
@@ -89,6 +93,10 @@ def bench_grid(M: int, N: int, oracle: int, ref_t: float | None):
         "converged": report.converged,
         "engine": report.engine,
         "l2_error": report.l2_error,
+        # achieved GB/s under the roofline traffic model (0 for the
+        # VMEM-resident engine): tools/bench_compare.py gates on it
+        "hbm_gbps": report.hbm_gbps,
+        "hbm_peak_frac": report.hbm_peak_frac,
         "ref_p100_s": ref_t,
         "vs_p100": round(ref_t / report.t_solver, 2) if ref_t else None,
     }
@@ -279,7 +287,12 @@ def bench_convergence(grid: tuple[int, int] = (400, 600), oracle: int = 546):
     while_loop (``obs.convergence`` — zero host syncs), summarised into
     a handful of scalars the artifact can carry, and cross-checked: the
     final traced step-norm must equal the solver's own ``diff`` exactly
-    (the trace records the loop's values, not a reconstruction)."""
+    (the trace records the loop's values, not a reconstruction).
+
+    Returns ``(row, ok, (result, trace))`` — the solve is also exactly
+    the input ``bench_spectrum`` needs for this grid, so the trace is
+    handed on instead of paying the full history solve twice per round.
+    """
     from poisson_ellipse_tpu.solver.engine import solve as engine_solve
 
     import jax.numpy as jnp
@@ -311,7 +324,89 @@ def bench_convergence(grid: tuple[int, int] = (400, 600), oracle: int = 546):
         f"on-device, diff {row['diff_first']:.3e} -> {row['diff_final']:.3e} "
         + ("— OK" if ok else "— MISMATCH vs PCGResult"),
     )
-    return row, ok
+    return row, ok, (result, trace)
+
+
+SPECTRUM_GRIDS = ((400, 600, 546), (800, 1200, 989))
+
+
+def bench_spectrum(precomputed=None):
+    """Spectral diagnostics rows: κ(M⁻¹A) and predicted-vs-actual
+    iterations per published grid (``obs.spectrum``).
+
+    ``precomputed`` maps a grid to an already-run history solve's
+    ``(result, trace)`` (bench_convergence hands its 400×600 one over —
+    same engine/dtype/history, no second full solve).
+
+    One history-enabled xla solve per grid; the Lanczos tridiagonal
+    reconstructed from the recorded α/β yields the condition number the
+    iteration-count wall is made of — the before/after yardstick any
+    preconditioner work (ROADMAP item 1) reports against, regression-
+    gated per round by ``tools/bench_compare.py`` (κ is grid-determined:
+    round-over-round drift means the estimator broke). Checks: oracle
+    iteration counts, a sane κ (finite, > 1, growing with the grid —
+    the measured growth law behind 546 → 5889), and the Ritz-model
+    iteration prediction within ±15% of actual."""
+    from poisson_ellipse_tpu.obs import spectrum as obs_spectrum
+    from poisson_ellipse_tpu.solver.engine import solve as engine_solve
+
+    import jax.numpy as jnp
+
+    rows = []
+    all_ok = True
+    prev_kappa = None
+    for M, N, oracle in SPECTRUM_GRIDS:
+        problem = Problem(M=M, N=N)
+        if precomputed and (M, N) in precomputed:
+            result, trace = precomputed[(M, N)]
+        else:
+            result, trace = engine_solve(
+                problem, "xla", jnp.float32, history=True
+            )
+        rep = obs_spectrum.spectrum_report(
+            trace, delta=problem.delta, actual_iters=int(result.iters)
+        )
+        pred = rep.get("predicted_iters")
+        err = rep.get("predicted_err")
+        ok = (
+            bool(result.converged)
+            and int(result.iters) == oracle
+            and rep.get("available", False)
+            and rep["kappa"] > 1.0
+            and math.isfinite(rep["kappa"])
+            and pred is not None
+            and err is not None
+            and abs(err) <= 0.15
+            and (prev_kappa is None or rep["kappa"] > prev_kappa)
+        )
+        all_ok &= ok
+        prev_kappa = rep.get("kappa") if rep.get("available") else prev_kappa
+        row = {
+            "grid": [M, N],
+            "engine": "xla",
+            "iters": int(result.iters),
+            "converged": bool(result.converged),
+            "kappa": rep.get("kappa"),
+            "lambda_min": rep.get("lambda_min"),
+            "lambda_max": rep.get("lambda_max"),
+            "cg_rate": rep.get("cg_rate"),
+            "iters_bound": rep.get("iters_bound"),
+            "predicted_iters": pred,
+            "predicted_err": err,
+            "stagnated": rep.get("stagnated"),
+        }
+        rows.append(row)
+        note(
+            f"  [spectrum] {M}x{N}: kappa={row['kappa']} "
+            f"rate={row['cg_rate']} predicted={pred} actual={row['iters']} "
+            f"(oracle {oracle}) "
+            + (
+                f"err={err:+.1%} — OK"
+                if ok
+                else "— MISMATCH (kappa/prediction out of band)"
+            ),
+        )
+    return rows, all_ok
 
 
 def bench_recovery(grid: tuple[int, int] = (400, 600), oracle: int = 546):
@@ -560,12 +655,16 @@ def main() -> int:
     eps_rows, oke = bench_eps_sweep()
     # observability rows (f32, so they run before the f64 flip below):
     # on-device convergence telemetry + static collective accounting
-    conv_row, okc = bench_convergence()
+    conv_row, okc, conv_solve = bench_convergence()
     coll_table, okl = bench_collectives()
+    # spectral diagnostics: kappa + predicted-vs-actual iterations per
+    # grid from the Lanczos-of-CG reconstruction (f32, pre-f64-flip);
+    # the 400x600 history solve is bench_convergence's, not a re-run
+    spec_rows, oks = bench_spectrum(precomputed={(400, 600): conv_solve})
     # resilience row: an injected NaN mid-solve must recover to oracle
     # parity through the guard (f32, before the f64 flip below)
     rec_row, okr = bench_recovery()
-    all_ok &= ok2 & okn & ok8 & okp & okt & okcs & oke & okc & okl & okr
+    all_ok &= ok2 & okn & ok8 & okp & okt & okcs & oke & okc & okl & oks & okr
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
     okf, f64_row = bench_f64_row()
@@ -598,6 +697,10 @@ def main() -> int:
         # static psum/ppermute accounting: the pipelined-1-vs-classical-2
         # property as a regression-checked artifact metric
         "collectives": coll_table,
+        # Lanczos spectral diagnostics: kappa(M^-1 A) + predicted-vs-
+        # actual iterations per grid (obs.spectrum), diffed between
+        # rounds by tools/bench_compare.py
+        "spectrum": spec_rows,
         # guarded-solve fault drill: injected NaN -> residual restart ->
         # oracle-parity reconvergence (resilience.guard)
         "recovery": rec_row,

@@ -21,7 +21,24 @@ import argparse
 import json
 import sys
 
+from poisson_ellipse_tpu.obs import metrics as obs_metrics
 from poisson_ellipse_tpu.obs.trace import event as trace_event, note
+
+
+def _record_table_metrics(table: dict) -> None:
+    """Fold one scaling/throughput table into the process metrics
+    registry (counters/gauges/histograms), so ``--metrics`` exports the
+    whole run as one OpenMetrics snapshot a scraper can diff."""
+    obs_metrics.counter("multichip_tables").inc()
+    t_hist = obs_metrics.histogram("multichip_t_solver_seconds")
+    for row in table.get("rows", []):
+        if row.get("t_solver_s") is not None:
+            t_hist.observe(row["t_solver_s"])
+        if row.get("solves_per_sec") is not None:
+            mesh = row.get("mesh") or ["?", "?"]
+            obs_metrics.gauge(
+                f"multichip_solves_per_sec_{mesh[0]}x{mesh[1]}"
+            ).set(row["solves_per_sec"])
 
 
 def main(argv=None) -> int:
@@ -56,7 +73,27 @@ def main(argv=None) -> int:
         "--virtual-devices", type=int, default=8,
         help="virtual CPU device count for the default (non --real) mode",
     )
+    ap.add_argument(
+        "--metrics", metavar="FILE",
+        help="export the run's table metrics (t_solver histogram, per-mesh "
+        "solves/sec gauges) as an OpenMetrics snapshot (obs.export)",
+    )
     args = ap.parse_args(argv)
+
+    exporter = None
+    if args.metrics:
+        from poisson_ellipse_tpu.obs.export import MetricsExporter
+
+        exporter = MetricsExporter(args.metrics)
+        # fail FAST: a metrics-path typo must not read as a bench
+        # failure after the whole scaling suite has run
+        err = exporter.try_write()
+        if err is not None:
+            print(
+                f"error: cannot write --metrics {args.metrics}: {err}",
+                file=sys.stderr,
+            )
+            return 2
 
     if not args.real:
         # the virtual-device flag and platform pin must land before the
@@ -117,6 +154,7 @@ def main(argv=None) -> int:
             batch=args.batch,
         )
         trace_event("multichip_table", **table)
+        _record_table_metrics(table)
         print(json.dumps(table))
         iters_ok = table["iters_consistent"] is not False
         if kind == "strong" and engine == "xla":
@@ -154,12 +192,21 @@ def main(argv=None) -> int:
             repeat=args.repeat,
         )
         trace_event("multichip_table", **table)
+        _record_table_metrics(table)
         print(json.dumps(table))
         coll = table["collectives_per_iter"]
         if not all(r["converged"] for r in table["rows"]) or (
             coll is not None and coll["psum"] != 1
         ):
             rc = 1
+    if exporter is not None:
+        # guarded final write: a filesystem dying mid-suite must warn,
+        # not crash away the computed bench verdict
+        err = exporter.try_write()
+        if err is not None:
+            note(f"warning: metrics snapshot failed: {err}")
+        else:
+            note(f"metrics snapshot: {exporter.path}")
     return rc
 
 

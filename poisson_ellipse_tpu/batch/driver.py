@@ -189,6 +189,7 @@ def solve_batched(
             _record(
                 events, "lane-quarantine", int(iters[lane]),
                 HEALTH_NONFINITE, engine, detail=f"lane {int(lane)}",
+                lane=int(lane),
             )
         quar_seen = quar
         if k >= max_iter or bool(np.all(conv | bd | quar)):

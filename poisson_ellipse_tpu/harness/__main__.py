@@ -17,6 +17,16 @@ Two observability entries ride the same prog:
   FLOPs/HBM bytes, the roofline traffic model's columns) with no solve
   executed — ``python -m poisson_ellipse_tpu.harness inspect pipelined
   --mode sharded --mesh 1 2``.
+- ``diagnose <engine>`` runs the measured half: one history-enabled
+  solve read through ``obs.spectrum`` (Ritz values, κ(M⁻¹A), CG rate,
+  predicted iterations, plateaus — verified bit-identical to a plain
+  solve), the fenced compile/H2D/solve/D2H phase profile with
+  measured-vs-modeled roofline columns (``obs.profile``), and an
+  optional OpenMetrics snapshot (``--metrics FILE``) —
+  ``python -m poisson_ellipse_tpu.harness diagnose xla --grid 400x600``.
+- ``--metrics FILE`` on the main prog exports the run's counters/
+  gauges/histograms as a periodically rewritten OpenMetrics snapshot
+  (``obs.export``).
 
 The serving surface:
 
@@ -75,15 +85,23 @@ EXIT_CODES_HELP = (
 )
 
 
+def _parse_grid(spec: str | None, default=(40, 40)) -> tuple[int, int]:
+    """One ``MxN`` grid spec (the sweep syntax's single-grid form), or
+    ``default`` when the flag was not given at all. Raises ValueError on
+    malformed input — an EMPTY spec included (a trailing comma in a
+    --grids list must error, not silently inject the default grid) —
+    which the subcommands catch into their curated exit-2 path."""
+    if spec is None:
+        return default
+    m, _, n = spec.lower().partition("x")
+    return (int(m), int(n or m))
+
+
 def _parse_grids(args) -> list[tuple[int, int]]:
     if args.M is not None:
         return [(args.M, args.N if args.N is not None else args.M)]
     if args.grids:
-        out = []
-        for spec in args.grids.split(","):
-            m, _, n = spec.lower().partition("x")
-            out.append((int(m), int(n or m)))
-        return out
+        return [_parse_grid(spec) for spec in args.grids.split(",")]
     return [(40, 40)]
 
 
@@ -154,12 +172,8 @@ def _run_inspect(argv: list[str]) -> int:
 
     from poisson_ellipse_tpu.obs import static_cost
 
-    if args.grid:
-        m, _, n = args.grid.lower().partition("x")
-        grid = (int(m), int(n or m))
-    else:
-        grid = (40, 40)
     try:
+        grid = _parse_grid(args.grid)
         report = static_cost.engine_report(
             Problem(M=grid[0], N=grid[1]),
             engine=args.engine,
@@ -295,6 +309,184 @@ def _report_inject(args, guarded) -> int:
     return 0 if record["converged"] else 1
 
 
+def _run_diagnose(argv: list[str]) -> int:
+    """The ``diagnose`` subcommand: spectrum + profile + export, one report.
+
+    Runs one history-enabled solve (``obs.convergence``) and reads the
+    spectral story out of it (``obs.spectrum``: Ritz values, κ(M⁻¹A),
+    CG rate, predicted iterations, plateaus), next to a plain solve that
+    pins the telemetry's zero-perturbation contract (bit-identical
+    iterates — diagnosing a solver must not change it), plus the fenced
+    compile/H2D/solve/D2H phase profile with the measured-vs-modeled
+    roofline columns (``obs.profile``), and optionally an OpenMetrics
+    snapshot (``--metrics FILE``) so the numbers land where a scraper
+    can find them.
+    """
+    import numpy as np
+
+    from poisson_ellipse_tpu.solver.engine import (
+        HISTORY_ENGINES,
+        build_solver,
+    )
+
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness diagnose",
+        description="Solver diagnostics in one report: Lanczos spectral "
+        "estimates (kappa, CG rate, predicted iterations, plateaus) from "
+        "the on-device convergence trace, fenced compile/H2D/solve/D2H "
+        "phase profiling with measured-vs-modeled roofline columns, and "
+        "OpenMetrics export. The history solve is verified bit-identical "
+        "to a plain solve: diagnosing never changes the solver.",
+    )
+    ap.add_argument(
+        "engine", nargs="?", default="auto",
+        help="history-capable engine to diagnose "
+        f"({', '.join(HISTORY_ENGINES)}; auto resolves to xla)",
+    )
+    ap.add_argument("--grid", help="MxN grid (default 40x40)")
+    ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument("--delta", type=float, default=1e-6)
+    ap.add_argument(
+        "--repeat", type=int, default=3,
+        help="solve-phase repetitions for the profile median",
+    )
+    ap.add_argument(
+        "--no-profile", action="store_true",
+        help="skip the phase profile (spectrum + contract check only)",
+    )
+    ap.add_argument(
+        "--no-xla-cost", action="store_true",
+        help="skip the XLA cost analysis columns of the profile",
+    )
+    ap.add_argument(
+        "--metrics", metavar="FILE",
+        help="write the diagnostic numbers as an OpenMetrics snapshot "
+        "(obs.export; atomic write)",
+    )
+    ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        obs_trace.start(args.trace)
+    try:
+        from poisson_ellipse_tpu.obs import profile as obs_profile
+        from poisson_ellipse_tpu.obs import spectrum as obs_spectrum
+
+        try:
+            grid = _parse_grid(args.grid)
+            problem = Problem(M=grid[0], N=grid[1], delta=args.delta)
+            jdtype = resolve_dtype(args.dtype)
+            if args.repeat < 1:
+                # checked HERE, not after two solves have been paid for:
+                # profile_engine would reject it with the same message
+                raise ValueError("repeat must be >= 1")
+            if args.engine not in HISTORY_ENGINES:
+                raise ValueError(
+                    f"engine {args.engine!r} records no history; diagnose "
+                    f"covers {', '.join(HISTORY_ENGINES)}"
+                )
+            if args.metrics:
+                from poisson_ellipse_tpu.obs.export import MetricsExporter
+
+                # fail FAST on an unwritable path — same exit-2 contract
+                # as the main prog's --metrics, checked BEFORE the
+                # solves below are paid for (overwritten with the real
+                # snapshot at the end)
+                err = MetricsExporter(
+                    args.metrics, registry=obs_metrics.MetricsRegistry()
+                ).try_write()
+                if err is not None:
+                    raise ValueError(
+                        f"cannot write --metrics {args.metrics}: {err}"
+                    )
+            # the contract half: history must not perturb one bit
+            solver, solver_args, engine = build_solver(
+                problem, args.engine, jdtype, history=True
+            )
+            result, trace = solver(*solver_args)
+            plain_solver, plain_args, _ = build_solver(
+                problem, engine, jdtype
+            )
+            plain = plain_solver(*plain_args)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        bit_identical = bool(
+            int(plain.iters) == int(result.iters)
+            and float(plain.diff) == float(result.diff)
+            and np.array_equal(np.asarray(plain.w), np.asarray(result.w))
+        )
+        spec = obs_spectrum.spectrum_report(
+            trace, delta=problem.delta, actual_iters=int(result.iters)
+        )
+        prof = None
+        if not args.no_profile:
+            prof = obs_profile.profile_engine(
+                problem, engine, jdtype, repeat=args.repeat,
+                with_xla_cost=not args.no_xla_cost,
+            )
+        record = {
+            "engine": engine,
+            "grid": list(grid),
+            "dtype": args.dtype,
+            "iters": int(result.iters),
+            "converged": bool(result.converged),
+            "bit_identical": bit_identical,
+            "spectrum": spec,
+            "profile": prof,
+        }
+        if args.metrics:
+            from poisson_ellipse_tpu.obs.export import MetricsExporter
+
+            reg = obs_metrics.MetricsRegistry()
+            reg.gauge("diagnose_iters").set(record["iters"])
+            if spec.get("available"):
+                reg.gauge("diagnose_kappa").set(spec["kappa"])
+                reg.gauge("diagnose_cg_rate").set(spec["cg_rate"])
+                if spec.get("predicted_iters") is not None:
+                    reg.gauge("diagnose_predicted_iters").set(
+                        spec["predicted_iters"]
+                    )
+            if prof is not None:
+                hist = reg.histogram("diagnose_solve_seconds")
+                hist.observe(prof["t_solve_s"])
+                reg.gauge("diagnose_compile_seconds").set(
+                    prof["t_compile_s"]
+                )
+                if prof.get("hbm_gbps") is not None:
+                    reg.gauge("diagnose_hbm_gbps").set(prof["hbm_gbps"])
+            record["metrics_path"] = MetricsExporter(
+                args.metrics, registry=reg
+            ).write()
+        obs_trace.event("diagnose_report", **record)
+        if args.json:
+            print(json.dumps(record))
+        else:
+            print(
+                f"diagnose {engine} {grid[0]}x{grid[1]} ({args.dtype}): "
+                f"{record['iters']} iterations, "
+                f"{'converged' if record['converged'] else 'NOT converged'}; "
+                "history-enabled iterates "
+                + (
+                    "BIT-IDENTICAL to the plain solve"
+                    if bit_identical
+                    else "DIFFER from the plain solve (contract violation)"
+                )
+            )
+            print(obs_spectrum.render_report(spec))
+            if prof is not None:
+                print(obs_profile.render_profile(prof))
+            if args.metrics:
+                print(f"metrics snapshot: {record['metrics_path']}")
+        if not bit_identical:
+            return 2
+        return 0 if record["converged"] else 1
+    finally:
+        if args.trace:
+            obs_trace.stop()
+
+
 def _run_warmup(argv: list[str]) -> int:
     """The ``warmup`` subcommand: pre-fill the compilation caches.
 
@@ -353,13 +545,7 @@ def _run_warmup(argv: list[str]) -> int:
             else (args.engine,)
         )
         try:
-            grids = [
-                (int(m), int(n or m))
-                for m, _, n in (
-                    spec.lower().partition("x")
-                    for spec in args.grids.split(",")
-                )
-            ]
+            grids = [_parse_grid(spec) for spec in args.grids.split(",")]
             lane_counts = [int(x) for x in args.lanes.split(",")]
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
@@ -419,6 +605,8 @@ def main(argv=None) -> int:
         return _run_inject(argv[1:])
     if argv and argv[0] == "warmup":
         return _run_warmup(argv[1:])
+    if argv and argv[0] == "diagnose":
+        return _run_diagnose(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.harness",
         description="Fictitious-domain Poisson PCG on TPU",
@@ -561,12 +749,53 @@ def main(argv=None) -> int:
         "events, counters; obs.trace schema) to FILE; POISSON_TRACE=FILE "
         "in the environment does the same without the flag",
     )
+    ap.add_argument(
+        "--metrics",
+        metavar="FILE",
+        help="export the run's counters/gauges/histograms as an "
+        "OpenMetrics snapshot to FILE (obs.export; written periodically "
+        "while running — point a scraper at it — and once at exit)",
+    )
+    ap.add_argument(
+        "--metrics-interval",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="periodic snapshot cadence for --metrics",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON line per run")
     args = ap.parse_args(argv)
 
+    if args.metrics and args.metrics_interval <= 0:
+        print(
+            "error: --metrics-interval must be positive (a zero cadence "
+            "would busy-spin the exporter thread)",
+            file=sys.stderr,
+        )
+        return 2
     if args.trace:
         obs_trace.start(args.trace)
     obs_trace.event("cli-args", argv=list(argv))
+    exporter = None
+    if args.metrics:
+        from poisson_ellipse_tpu.obs.export import MetricsExporter
+
+        exporter = MetricsExporter(
+            args.metrics, interval_s=args.metrics_interval
+        )
+        # fail FAST on an unwritable path: a snapshot that can only
+        # fail at exit would crash the finally block after a good
+        # run — bad input is the up-front exit-2 contract
+        err = exporter.try_write()
+        if err is not None:
+            print(
+                f"error: cannot write --metrics {args.metrics}: {err}",
+                file=sys.stderr,
+            )
+            if args.trace:
+                obs_trace.stop()
+            return 2
+        exporter.start()
     rc = None
     try:
         rc = _run_cli(args)
@@ -574,8 +803,20 @@ def main(argv=None) -> int:
     finally:
         # emit/reset unconditionally (crashed runs included): per-run
         # aggregates — a later main() in the same process must not
-        # report this run's counts as its own
+        # report this run's counts as its own. The metrics snapshot
+        # flushes BEFORE the reset, or the exported file would be empty.
         obs_metrics.REGISTRY.emit()
+        if exporter is not None:
+            # the path was validated up front, but a filesystem can
+            # still die mid-run: report it, never mask the solve's
+            # result or skip the reset/stop cleanup below
+            exporter.stop(final_write=False)
+            err = exporter.try_write()
+            if err is not None:
+                print(
+                    f"warning: metrics snapshot failed: {err}",
+                    file=sys.stderr,
+                )
         obs_metrics.REGISTRY.reset()
         obs_trace.event("cli-exit", rc="error" if rc is None else rc)
         if args.trace:
@@ -613,7 +854,11 @@ def _run_cli(args) -> int:
             )
             return 2
 
-    grids = _parse_grids(args)
+    try:
+        grids = _parse_grids(args)
+    except ValueError as e:
+        print(f"error: invalid --grids: {e}", file=sys.stderr)
+        return 2
     # a sweep re-fingerprints the checkpoint each run, so a shared directory
     # would refuse every run after the first — key per-run subdirectories
     sweeping = len(grids) * len(eps_values) > 1
@@ -711,6 +956,9 @@ def _run_cli(args) -> int:
             if report.converged:
                 obs_metrics.counter("runs_converged").inc()
             obs_metrics.gauge("last_iters").set(report.iters)
+            # latency distribution across the run/sweep: the p50/p90/p99
+            # the --metrics OpenMetrics snapshot renders as a summary
+            obs_metrics.histogram("solve_seconds").observe(report.t_solver)
             phases = None
             if args.profile and args.mode == "native":
                 print(

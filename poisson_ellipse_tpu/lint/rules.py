@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU009.
+"""The tpulint rule registry: TPU001–TPU011.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -28,6 +28,11 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | loop bodies, and calls of static-argnum jitted|
 |        |                    | callables whose static argument varies with a |
 |        |                    | loop — a fresh trace+compile per iteration    |
+| TPU011 | unfenced-timing    | a `time.time()`/`perf_counter()` span closing |
+|        |                    | over a jitted dispatch with no fence between  |
+|        |                    | the dispatch and the clock read — async       |
+|        |                    | dispatch means the bracket timed the queue,   |
+|        |                    | not the work                                  |
 """
 
 from __future__ import annotations
@@ -1130,6 +1135,170 @@ def check_recompile_hazard(module: Module, config: LintConfig) -> Iterator[Findi
                     "solvers' traced `limit` pattern) or hoist the call",
                 )
                 break
+
+
+# --------------------------------------------------------------------------
+# TPU011 — unfenced timing spans around jitted dispatches
+# --------------------------------------------------------------------------
+
+# wall-clock sources whose bracket defines a timing span
+_TIMER_CALLS = frozenset({"time.time", "time.perf_counter", "time.monotonic"})
+
+
+def _is_timer_call(module: Module, node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and (module.qualname(node.func) or "") in _TIMER_CALLS
+    )
+
+
+def _jitted_names(module: Module, config: LintConfig) -> frozenset[str]:
+    """Names statically known to hold dispatchable compiled callables:
+    bound from a ``jax.jit(...)`` construction, from a
+    ``.lower().compile()`` AOT chain, or (tuple-unpacked) from a call to
+    a jit factory (``jit-factory-patterns`` — the repo's ``build_*``
+    return their jitted solver). Over-approximate on tuple targets: the
+    non-callable elements are never *called*, so they cannot fire."""
+    out: set[str] = set()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        leaf = (module.qualname(value.func) or "").rsplit(".", 1)[-1]
+        if not (
+            module.jit_construction(value) is not None
+            or _is_lower_compile_chain(value)
+            or any(
+                fnmatch.fnmatch(leaf, pat)
+                for pat in config.jit_factory_patterns
+            )
+        ):
+            continue
+        for target in node.targets:
+            out.update(
+                n.id for n in ast.walk(target) if isinstance(n, ast.Name)
+            )
+    return frozenset(out)
+
+
+def _is_fence_call(module: Module, node: ast.Call, config: LintConfig) -> bool:
+    """A call that blocks the host on device work: a configured fence
+    wrapper (``host-sync-fns`` — the same allowlist TPU008 treats as a
+    per-iteration sync), ``jax.block_until_ready``, or any
+    ``.block_until_ready()`` method."""
+    q = module.qualname(node.func) or ""
+    if _is_fence_wrapper(q, config) or q == "jax.block_until_ready":
+        return True
+    return (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "block_until_ready"
+    )
+
+
+@rule(
+    "TPU011",
+    "unfenced-timing",
+    "time.time()/perf_counter() span closing over a jitted dispatch with "
+    "no block_until_ready/fence between the dispatch and the clock read",
+)
+def check_unfenced_timing(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """JAX dispatch is asynchronous: ``t0 = perf_counter(); out =
+    solver(x); t = perf_counter() - t0`` times the enqueue, not the
+    solve — a number that *looks* plausible and is off by the whole
+    device execution (the bug class every fenced timing site in
+    ``harness.run`` exists to avoid). The rule finds a span —
+    ``NAME = <timer>()`` later read as ``<timer>() - NAME`` in the same
+    scope — containing a call to a statically-known jitted callable
+    (:func:`_jitted_names`) with no fence (``host-sync-fns`` config,
+    ``jax.block_until_ready``, or a ``.block_until_ready()`` method —
+    the TPU008 fence allowlist, reused) between the LAST such dispatch
+    and the closing clock read. Deadline checks (``timer() - t0`` in a
+    different function, the guard's pattern) and compile/host-only
+    brackets stay silent by construction."""
+    jitted = _jitted_names(module, config)
+    if not jitted:
+        return
+
+    def scope_nodes(scope):
+        """Nodes belonging to ``scope`` itself — nested function/lambda
+        bodies are their own span scopes (a start in one function and a
+        clock read in another is not a span) and are not descended into."""
+        skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        stack = [n for n in scope.body if not isinstance(n, skip)]
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(
+                c
+                for c in ast.iter_child_nodes(node)
+                if not isinstance(c, skip)
+            )
+
+    scopes: list[ast.AST] = [module.tree]
+    scopes += [
+        n
+        for n in ast.walk(module.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    emitted: set[tuple[int, int]] = set()
+    for scope in scopes:
+        starts: dict[str, list[int]] = {}
+        closes: list[tuple[int, str, ast.AST]] = []
+        jit_lines: list[int] = []
+        fence_lines: list[int] = []
+        for node in scope_nodes(scope):
+            if isinstance(node, ast.Assign) and _is_timer_call(
+                module, node.value
+            ):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        starts.setdefault(target.id, []).append(node.lineno)
+            elif (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and _is_timer_call(module, node.left)
+                and isinstance(node.right, ast.Name)
+            ):
+                closes.append((node.lineno, node.right.id, node))
+            elif isinstance(node, ast.Call):
+                if _is_fence_call(module, node, config):
+                    fence_lines.append(node.lineno)
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in jitted
+                ):
+                    jit_lines.append(node.lineno)
+        for close_line, name, close_node in closes:
+            opened = [ln for ln in starts.get(name, []) if ln < close_line]
+            if not opened:
+                continue
+            start_line = max(opened)
+            dispatches = [
+                ln for ln in jit_lines if start_line < ln < close_line
+            ]
+            if not dispatches:
+                continue
+            last = max(dispatches)
+            if any(last <= ln <= close_line for ln in fence_lines):
+                continue
+            key = (close_node.lineno, close_node.col_offset)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            yield _finding(
+                module,
+                close_node,
+                "TPU011",
+                f"timing span `{name}` closes over the jitted dispatch at "
+                f"line {last} with no fence: dispatch is asynchronous, so "
+                "this bracket measured the enqueue, not the device work — "
+                "fence the result (utils.timing.fence / "
+                "jax.block_until_ready) before reading the clock, or "
+                "suppress with a note if the enqueue itself is the "
+                "measurement",
+            )
 
 
 @rule(

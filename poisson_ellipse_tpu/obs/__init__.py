@@ -17,11 +17,19 @@ Three legs, mirroring what a production solver service has to expose:
   turns the pipelined engine's "1 collective/iter vs classical 2" claim
   into a regression-checked metric (``harness inspect``, BENCH
   artifacts).
+- :mod:`.spectrum` — spectral diagnostics from the convergence trace:
+  the Lanczos tridiagonal hiding in the recorded α/β, Ritz values,
+  κ(M⁻¹A), the asymptotic CG rate, sharp iteration prediction and
+  plateau detection (``harness diagnose``, the ``spectrum`` BENCH key).
+- :mod:`.profile` — fenced compile/H2D/solve/D2H phase profiling with
+  measured GB/s / FLOP/s joined against the static traffic model.
+- :mod:`.export` — OpenMetrics text rendering of a metrics snapshot +
+  atomic/periodic snapshot-to-file wiring (``--metrics FILE``).
 
-:mod:`.static_cost` imports the solver engines, so it is intentionally
-NOT imported here — ``from poisson_ellipse_tpu.obs import static_cost``
-at use sites keeps this package importable from inside the solver
-modules it instruments.
+:mod:`.static_cost` and :mod:`.profile` import the solver engines, so
+they are intentionally NOT imported here — ``from poisson_ellipse_tpu.
+obs import static_cost`` (or ``profile``) at use sites keeps this
+package importable from inside the solver modules it instruments.
 """
 
 from poisson_ellipse_tpu.obs.convergence import (
@@ -31,22 +39,37 @@ from poisson_ellipse_tpu.obs.convergence import (
     history_record,
     trace_of,
 )
-from poisson_ellipse_tpu.obs.metrics import REGISTRY, MetricsRegistry, counter, gauge
+from poisson_ellipse_tpu.obs.export import MetricsExporter, render_openmetrics
+from poisson_ellipse_tpu.obs.metrics import (
+    REGISTRY,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+)
+from poisson_ellipse_tpu.obs.spectrum import ritz_values, spectrum_report
 from poisson_ellipse_tpu.obs.trace import Tracer, event, note, span, start, stop
 
 __all__ = [
     "HISTORY_FIELDS",
     "ConvergenceTrace",
+    "Histogram",
+    "MetricsExporter",
     "MetricsRegistry",
     "REGISTRY",
     "Tracer",
     "counter",
     "event",
     "gauge",
+    "histogram",
     "history_init",
     "history_record",
     "note",
+    "render_openmetrics",
+    "ritz_values",
     "span",
+    "spectrum_report",
     "start",
     "stop",
     "trace_of",

@@ -1,4 +1,4 @@
-"""Counters and gauges: the trace stream's aggregate half.
+"""Counters, gauges and histograms: the trace stream's aggregate half.
 
 The reference's five stage4 accumulators (``T_gpu/T_copy/T_mpi/T_prec/
 T_dot``, ``poisson_mpi_cuda2.cu:696-700``) are exactly this shape — named
@@ -12,6 +12,10 @@ Counters and gauges are *host-side* state: incrementing one from inside
 a traced loop body would be a host sync per iteration (tpulint TPU008's
 anti-pattern). On-device per-iteration series belong to
 :mod:`.convergence`; this module is for per-run aggregates.
+
+:class:`Histogram` adds the latency-distribution kind (p50/p90/p99 over
+a sliding window, lifetime count/sum); :mod:`.export` renders a
+registry snapshot in the OpenMetrics text format for scrapers.
 """
 
 from __future__ import annotations
@@ -46,6 +50,54 @@ class Gauge:
         self.value = float(v)
 
 
+# sliding-window cap per histogram: quantiles are computed over the most
+# recent observations only, so a long-lived serving process stays O(1)
+HISTOGRAM_WINDOW = 4096
+
+HISTOGRAM_QUANTILES = (0.5, 0.9, 0.99)
+
+
+@dataclasses.dataclass
+class Histogram:
+    """Latency-style observations with p50/p90/p99 quantiles.
+
+    ``count``/``sum`` are lifetime totals; quantiles are nearest-rank
+    over a sliding window of the last :data:`HISTOGRAM_WINDOW`
+    observations (a bounded buffer — good enough for run reports and
+    the OpenMetrics summary rendering, not a streaming sketch).
+    """
+
+    name: str
+    count: int = 0
+    sum: float = 0.0
+    _window: list = dataclasses.field(default_factory=list)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self._window.append(v)
+        if len(self._window) > HISTOGRAM_WINDOW:
+            del self._window[: len(self._window) - HISTOGRAM_WINDOW]
+
+    def quantile(self, q: float) -> float | None:
+        """Nearest-rank quantile over the window (None when empty)."""
+        if not self._window:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        ordered = sorted(self._window)
+        idx = min(int(q * len(ordered)), len(ordered) - 1)
+        return ordered[idx]
+
+    def summary(self) -> dict:
+        """{"count", "sum", "p50", "p90", "p99"} — the snapshot entry."""
+        out = {"count": self.count, "sum": self.sum}
+        for q in HISTOGRAM_QUANTILES:
+            out[f"p{int(q * 100)}"] = self.quantile(q)
+        return out
+
+
 class MetricsRegistry:
     """Create-or-get registry of counters and gauges.
 
@@ -58,48 +110,87 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def _check_kind(self, name: str, want: str) -> None:
+        for kind, table in (
+            ("counter", self._counters),
+            ("gauge", self._gauges),
+            ("histogram", self._histograms),
+        ):
+            if kind != want and name in table:
+                raise ValueError(f"{name!r} is already a {kind}")
 
     def counter(self, name: str) -> Counter:
         with self._lock:
-            if name in self._gauges:
-                raise ValueError(f"{name!r} is already a gauge")
+            self._check_kind(name, "counter")
             return self._counters.setdefault(name, Counter(name))
 
     def gauge(self, name: str) -> Gauge:
         with self._lock:
-            if name in self._counters:
-                raise ValueError(f"{name!r} is already a counter")
+            self._check_kind(name, "gauge")
             return self._gauges.setdefault(name, Gauge(name))
 
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            self._check_kind(name, "histogram")
+            return self._histograms.setdefault(name, Histogram(name))
+
     def snapshot(self) -> dict:
-        """{"counters": {name: value}, "gauges": {name: value}} — set
-        gauges only (an unobserved gauge has nothing to report)."""
+        """{"counters": {...}, "gauges": {...}, "histograms": {...}} —
+        set gauges only (an unobserved gauge has nothing to report).
+
+        Deterministic: every table is name-sorted, not creation-ordered,
+        so two snapshots of the same state serialize identically and
+        snapshot-derived artifacts (OpenMetrics files, trace records)
+        diff cleanly across runs.
+        """
         with self._lock:
             return {
-                "counters": {n: c.value for n, c in self._counters.items()},
+                "counters": {
+                    n: self._counters[n].value
+                    for n in sorted(self._counters)
+                },
                 "gauges": {
-                    n: g.value
-                    for n, g in self._gauges.items()
-                    if g.value is not None
+                    n: self._gauges[n].value
+                    for n in sorted(self._gauges)
+                    if self._gauges[n].value is not None
+                },
+                "histograms": {
+                    n: self._histograms[n].summary()
+                    for n in sorted(self._histograms)
+                    if self._histograms[n].count
                 },
             }
 
     def emit(self, tracer=None) -> None:
         """Publish every metric into the JSONL trace (ambient tracer by
-        default; silently nothing when tracing is inactive)."""
+        default; silently nothing when tracing is inactive or the tracer
+        is already closed — a late emit after ``trace.stop()`` must not
+        raise on a closed file, it has nowhere to publish)."""
         tracer = tracer or _trace.active()
-        if tracer is None:
+        if tracer is None or getattr(tracer, "closed", False):
             return
         snap = self.snapshot()
-        for name, value in sorted(snap["counters"].items()):
+        for name, value in snap["counters"].items():
             tracer.emit("counter", name, value=value)
-        for name, value in sorted(snap["gauges"].items()):
+        for name, value in snap["gauges"].items():
             tracer.emit("gauge", name, value=value)
+        for name, summary in snap["histograms"].items():
+            # the closed record-kind set has no histogram kind: quantiles
+            # publish as gauges, the lifetime count as a counter
+            tracer.emit("counter", f"{name}_count", value=summary["count"])
+            tracer.emit("gauge", f"{name}_sum", value=summary["sum"])
+            for q in HISTOGRAM_QUANTILES:
+                key = f"p{int(q * 100)}"
+                if summary[key] is not None:
+                    tracer.emit("gauge", f"{name}_{key}", value=summary[key])
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._histograms.clear()
 
 
 # the process-default registry (the harness/bench drivers use this one)
@@ -112,3 +203,7 @@ def counter(name: str) -> Counter:
 
 def gauge(name: str) -> Gauge:
     return REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return REGISTRY.histogram(name)

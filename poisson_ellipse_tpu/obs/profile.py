@@ -1,0 +1,235 @@
+"""Fenced wall-clock phase profiling: where a solve's seconds actually go.
+
+``harness.profile`` answers "what does one *iteration* spend per op"
+(segmented on-device replay); this module answers the serving question
+one level up: for one engine on one grid, how long are **compile**,
+**H2D**, **solve** and **D2H** — the four phases a cold worker pays —
+and what bandwidth/FLOP rate did the solve phase actually achieve,
+joined against the static traffic model (``obs.static_cost`` /
+``harness.roofline``) into a measured-vs-modeled roofline table with a
+%-of-model column. Every phase is bracketed by real fences
+(``utils.timing.fence`` = ``block_until_ready`` + a scalar fetch):
+unfenced timing of async dispatches measures the queue, not the work —
+the hazard tpulint TPU011 now flags structurally.
+
+Phase map (one row per engine):
+
+  t_build_s    host assembly + solver construction (f64 assembly,
+               rounded once — the operand-fidelity contract)
+  t_compile_s  ``jit(...).lower().compile()`` — the cold-start cost the
+               AOT warm pool (``runtime.compile_cache``) exists to hide
+  t_h2d_s      device_put of the host operands, fenced
+  t_solve_s    median of ``repeat`` fenced dispatches of the compiled
+               executable (plain-dispatch protocol: this is a phase
+               *split*, not the bench's marginal-cost headline)
+  t_d2h_s      materialising the solution grid on host
+
+Rates, from the solve phase:
+
+  hbm_gbps         modeled bytes/iter × iters / t_solve — achieved
+                   streaming bandwidth under the traffic model (the
+                   number the 82%-of-peak claim is made of)
+  hbm_gbps_xla     XLA cost-analysis bytes/iter × iters / t_solve —
+                   the compiler's own accounting of the same run
+  flops_per_s      XLA cost-analysis FLOPs/iter × iters / t_solve
+  pct_of_model     XLA bytes estimate / modeled bytes × 100 — the
+                   %-of-model column; drift here means the traffic
+                   model rotted against the compiled artifact
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.utils.timing import fence
+
+PROFILE_PHASES = ("build", "compile", "h2d", "solve", "d2h")
+
+
+def profile_engine(
+    problem: Problem,
+    engine: str = "auto",
+    dtype=jnp.float32,
+    repeat: int = 3,
+    with_xla_cost: bool = True,
+) -> dict:
+    """One engine's fenced phase/rate record (see module docstring).
+
+    Single-lane engines only — the batched engines report throughput,
+    not the single-solve phase split (``harness --lanes``).
+    """
+    import numpy as np
+
+    from poisson_ellipse_tpu.harness.roofline import (
+        hbm_peak_bytes_per_s,
+        modeled_hbm_bytes_per_iter,
+        passes_per_iter,
+    )
+    from poisson_ellipse_tpu.obs.static_cost import xla_cost
+    from poisson_ellipse_tpu.solver.engine import BATCHED_ENGINES, build_solver
+
+    if engine in BATCHED_ENGINES:
+        raise ValueError(
+            f"engine {engine!r} is lane-batched; the phase profile covers "
+            "single-solve engines (throughput is the lanes protocol)"
+        )
+    if repeat < 1:
+        raise ValueError("repeat must be >= 1")
+
+    t0 = time.perf_counter()
+    solver, args, engine = build_solver(problem, engine, dtype)
+    fence(args)
+    t_build = time.perf_counter() - t0
+
+    # the cold-start phase: trace + XLA/Mosaic compile, AOT so the solve
+    # phase below times pure execution of the same executable
+    t0 = time.perf_counter()
+    compiled = solver.lower(*args).compile()
+    t_compile = time.perf_counter() - t0
+
+    # H2D: re-stage the operands from host copies, fenced — what a
+    # serving worker pays to place a request's operands
+    host_args = [np.asarray(a) for a in args]
+    t0 = time.perf_counter()
+    dev_args = [jax.device_put(a) for a in host_args]
+    fence(dev_args)
+    t_h2d = time.perf_counter() - t0
+
+    result = compiled(*dev_args)
+    # warm-up fence outside every timed bracket (first dispatch may
+    # still pay allocator work)
+    fence(result)
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        result = compiled(*dev_args)
+        # the sync IS the measurement: each bracket must close on
+        # completed device work (the TPU011 contract)
+        fence(result)  # tpulint: disable=TPU008
+        times.append(time.perf_counter() - t0)
+    t_solve = statistics.median(times)
+
+    t0 = time.perf_counter()
+    w_host = np.asarray(result.w)
+    t_d2h = time.perf_counter() - t0
+    del w_host
+
+    iters = int(result.iters)
+    try:
+        passes = passes_per_iter(problem, engine, dtype)
+        modeled_bytes = modeled_hbm_bytes_per_iter(problem, engine, dtype)
+    except ValueError:  # an engine without a traffic model stays profileable
+        passes, modeled_bytes = None, None
+    cost = xla_cost(solver, args) if with_xla_cost else None
+    est_bytes = cost.get("bytes_accessed") if cost else None
+    est_flops = cost.get("flops") if cost else None
+
+    def rate(bytes_per_iter):
+        if not bytes_per_iter or t_solve <= 0 or iters <= 0:
+            return None
+        return bytes_per_iter * iters / t_solve
+
+    hbm = rate(modeled_bytes)
+    hbm_xla = rate(est_bytes)
+    flops = rate(est_flops)
+    peak = hbm_peak_bytes_per_s()
+    return {
+        "engine": engine,
+        "grid": [problem.M, problem.N],
+        "dtype": jnp.dtype(dtype).name,
+        "iters": iters,
+        "converged": bool(result.converged),
+        "t_build_s": round(t_build, 5),
+        "t_compile_s": round(t_compile, 5),
+        "t_h2d_s": round(t_h2d, 5),
+        "t_solve_s": round(t_solve, 5),
+        "t_d2h_s": round(t_d2h, 5),
+        "us_per_iter": round(t_solve / iters * 1e6, 2) if iters else None,
+        "modeled_passes_per_iter": passes,
+        "modeled_hbm_bytes_per_iter": modeled_bytes,
+        "est_hbm_bytes_per_iter": est_bytes,
+        "hbm_gbps": round(hbm / 1e9, 3) if hbm else None,
+        "hbm_gbps_xla": round(hbm_xla / 1e9, 3) if hbm_xla else None,
+        "flops_per_s": round(flops, 1) if flops else None,
+        "pct_of_model": (
+            round(100.0 * est_bytes / modeled_bytes, 1)
+            if est_bytes and modeled_bytes
+            else None
+        ),
+        "hbm_peak_frac": (
+            round(hbm / peak, 4) if hbm and peak else None
+        ),
+    }
+
+
+def profile_table(
+    problem: Problem,
+    engines: tuple[str, ...] = ("xla",),
+    dtype=jnp.float32,
+    repeat: int = 3,
+    with_xla_cost: bool = True,
+) -> list[dict]:
+    """One :func:`profile_engine` row per engine (skipping engines that
+    refuse to build for this problem/dtype — a capacity-gated Pallas
+    engine on the wrong part must not kill the table)."""
+    rows = []
+    for engine in engines:
+        try:
+            rows.append(
+                profile_engine(
+                    problem, engine, dtype, repeat=repeat,
+                    with_xla_cost=with_xla_cost,
+                )
+            )
+        except ValueError:
+            # engine/dtype combination the registry rejects: skip the row
+            continue
+    return rows
+
+
+def render_profile(rows) -> str:
+    """The measured-vs-modeled roofline table (``harness diagnose``).
+
+    Accepts one row or a list. The %-of-model column is XLA's own
+    bytes-accessed estimate over the roofline traffic model's bytes —
+    100% means the model still matches the compiled artifact.
+    """
+    if isinstance(rows, dict):
+        rows = [rows]
+    if not rows:
+        return "profile: no engine produced a row"
+    grid = rows[0]["grid"]
+    lines = [
+        f"phase profile {grid[0]}x{grid[1]} ({rows[0]['dtype']}, fenced "
+        "wall clock; solve = median plain dispatch):",
+        "  engine            compile      H2D    solve      D2H   "
+        "us/iter   GB/s(model)  GB/s(XLA)  %of-model   MFLOP/s",
+    ]
+    for r in rows:
+        def col(v, fmt="{:8.4f}", na="     n/a"):
+            return fmt.format(v) if v is not None else na
+
+        lines.append(
+            f"  {r['engine']:<16s}"
+            f" {col(r['t_compile_s'])}"
+            f" {col(r['t_h2d_s'])}"
+            f" {col(r['t_solve_s'])}"
+            f" {col(r['t_d2h_s'])}"
+            f"  {col(r['us_per_iter'], '{:8.1f}')}"
+            f"     {col(r['hbm_gbps'], '{:9.2f}', '      n/a')}"
+            f"  {col(r['hbm_gbps_xla'], '{:9.2f}', '      n/a')}"
+            f"  {col(r['pct_of_model'], '{:8.1f}%', '     n/a ')}"
+            f" {col(r['flops_per_s'] / 1e6 if r['flops_per_s'] else None, '{:9.1f}', '      n/a')}"
+        )
+    frac_rows = [r for r in rows if r.get("hbm_peak_frac") is not None]
+    for r in frac_rows:
+        lines.append(
+            f"  {r['engine']}: {r['hbm_peak_frac']:.1%} of this part's "
+            "HBM peak (traffic model)"
+        )
+    return "\n".join(lines)

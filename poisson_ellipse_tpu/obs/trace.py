@@ -20,13 +20,17 @@ Record schema (one JSON object per line; :func:`validate_record`):
 
   | key    | required | meaning                                        |
   |--------|----------|------------------------------------------------|
-  | v      | yes      | schema version (``SCHEMA_VERSION``)            |
+  | v      | yes      | schema version (``SCHEMA_VERSION``; v1 traces  |
+  |        |          | still validate — v2 only added ``lane``)       |
   | run    | yes      | run id, shared by every record of one tracer   |
   | t      | yes      | seconds since the tracer started (monotonic)   |
   | kind   | yes      | meta / span / event / counter / gauge          |
   | name   | yes      | record name (``phase:solver``, ``bench_row``…) |
   | dur    | span     | span duration in seconds (monotonic)           |
   | value  | ctr/gauge| the counter/gauge value at emit time           |
+  | lane   | no       | lane index of a lane-addressed event (the      |
+  |        |          | batched engines' quarantine/fault records) —   |
+  |        |          | first-class so lane filters need no field poke |
   | fields | no       | free-form JSON object of extra attributes      |
 
 Timing inside traced device loops is out of scope by design: a span is a
@@ -43,13 +47,19 @@ import sys
 import time
 import uuid
 
-SCHEMA_VERSION = 1
+# v2 added the optional top-level ``lane`` key (lane-addressed batched
+# events); v1 records remain valid — see VALID_VERSIONS
+SCHEMA_VERSION = 2
+
+VALID_VERSIONS = frozenset({1, 2})
 
 KINDS = frozenset({"meta", "span", "event", "counter", "gauge"})
 
 # the closed top-level key set: unknown keys fail validation so the
 # schema cannot grow silently (add here + bump SCHEMA_VERSION instead)
-_ALLOWED_KEYS = frozenset({"v", "run", "t", "kind", "name", "dur", "value", "fields"})
+_ALLOWED_KEYS = frozenset(
+    {"v", "run", "t", "kind", "name", "dur", "value", "lane", "fields"}
+)
 
 ENV_VAR = "POISSON_TRACE"
 
@@ -87,7 +97,7 @@ class Tracer:
 
     def emit(self, kind: str, name: str, dur: float | None = None,
              value: float | None = None, fields: dict | None = None,
-             t: float | None = None) -> None:
+             t: float | None = None, lane: int | None = None) -> None:
         if kind not in KINDS:
             raise ValueError(f"unknown record kind: {kind!r} (one of {sorted(KINDS)})")
         rec: dict = {
@@ -103,6 +113,8 @@ class Tracer:
             rec["dur"] = round(dur, 6)
         if value is not None:
             rec["value"] = value
+        if lane is not None:
+            rec["lane"] = int(lane)
         if fields:
             rec["fields"] = fields
         # default=str: a numpy scalar or Path in a field must degrade to
@@ -110,11 +122,18 @@ class Tracer:
         self._fh.write(json.dumps(rec, default=str) + "\n")
         self._fh.flush()
 
-    def event(self, name: str, **fields) -> None:
-        self.emit("event", name, fields=fields or None)
+    def event(self, name: str, lane: int | None = None, **fields) -> None:
+        self.emit("event", name, fields=fields or None, lane=lane)
 
     def span(self, name: str, **fields) -> "_Span":
         return _Span(self, name, fields)
+
+    @property
+    def closed(self) -> bool:
+        """True once the sink can no longer accept records (late
+        emitters — metrics flushes after ``stop()`` — check this
+        instead of writing into a closed file)."""
+        return bool(getattr(self._fh, "closed", False))
 
     def close(self) -> None:
         if self._owns and not self._fh.closed:
@@ -224,10 +243,10 @@ def span_event(name: str, dur: float, **fields) -> None:
         )
 
 
-def event(name: str, **fields) -> None:
+def event(name: str, lane: int | None = None, **fields) -> None:
     tracer = active()
     if tracer:
-        tracer.event(name, **fields)
+        tracer.event(name, lane=lane, **fields)
 
 
 def note(message: str, file=None, _event: str = "note", **fields) -> None:
@@ -257,8 +276,11 @@ def validate_record(rec) -> str | None:
     for key in ("v", "run", "t", "kind", "name"):
         if key not in rec:
             return f"missing required key: {key}"
-    if rec["v"] != SCHEMA_VERSION:
-        return f"schema version {rec['v']!r} != {SCHEMA_VERSION}"
+    if rec["v"] not in VALID_VERSIONS:
+        return (
+            f"schema version {rec['v']!r} not one of "
+            f"{sorted(VALID_VERSIONS)}"
+        )
     if not isinstance(rec["run"], str) or not rec["run"]:
         return "run must be a non-empty string"
     if not isinstance(rec["t"], (int, float)) or rec["t"] < 0:
@@ -273,6 +295,10 @@ def validate_record(rec) -> str | None:
     if rec["kind"] in ("counter", "gauge"):
         if not isinstance(rec.get("value"), (int, float)):
             return f"{rec['kind']} records need a numeric value"
+    if "lane" in rec:
+        lane = rec["lane"]
+        if isinstance(lane, bool) or not isinstance(lane, int) or lane < 0:
+            return "lane must be a non-negative integer"
     if "fields" in rec and not isinstance(rec["fields"], dict):
         return "fields must be an object"
     return None

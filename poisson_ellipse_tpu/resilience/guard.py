@@ -564,10 +564,14 @@ def guarded_solve(
 
 
 def _record(events: list[RecoveryEvent], kind: str, at_iter: int, health: int,
-            engine: str, detail: str = "") -> None:
+            engine: str, detail: str = "", lane: int | None = None) -> None:
     events.append(RecoveryEvent(kind, at_iter, health, engine, detail))
     obs_trace.event(
         f"recovery:{kind}",
+        # lane-addressed events (the batched driver's quarantines) carry
+        # the lane as the schema's first-class top-level key, not a
+        # fields poke — obs.trace.validate_record checks it
+        lane=lane,
         iter=at_iter,
         health=health_name(health) if health else "error",
         engine=engine,

@@ -74,6 +74,16 @@ ENGINES = (
 # ``lanes`` independent solves; results are per-lane (BatchedPCGResult)
 BATCHED_ENGINES = ("batched", "batched-pipelined")
 
+# engines that can record on-device convergence history
+# (``history=True`` → (PCGResult, obs.ConvergenceTrace)): the XLA-loop
+# engines. The VMEM mega-kernels keep their scalars in kernel scratch,
+# the batched engines carry per-lane recurrences — neither records.
+# "auto" resolves to xla under history=True. The single source of truth
+# for every history consumer (harness diagnose, obs.spectrum callers).
+HISTORY_ENGINES = (
+    "auto", "xla", "pallas", "fused", "pipelined", "pipelined-pallas",
+)
+
 
 def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
     """The concrete engine "auto" resolves to for this problem/dtype.
@@ -170,12 +180,12 @@ def build_solver(
         # the mega-kernel engines auto would pick cannot record: take the
         # reference-trajectory engine instead of failing a telemetry ask
         engine = "xla"
-    if history and engine in ("resident", "streamed", "xl"):
+    if history and engine in ENGINES and engine not in HISTORY_ENGINES:
         raise ValueError(
             f"engine {engine!r} keeps its scalar recurrence in VMEM kernel "
-            "scratch and cannot record history; use xla/pallas/fused/"
-            "pipelined/pipelined-pallas (or engine='auto', which resolves "
-            "to xla under history=True)"
+            "scratch and cannot record history; use one of "
+            f"{', '.join(HISTORY_ENGINES[1:])} (or engine='auto', which "
+            "resolves to xla under history=True)"
         )
     if engine == "auto":
         import jax

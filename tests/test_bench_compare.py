@@ -1,0 +1,216 @@
+"""tools/bench_compare.py: the perf regression gate, under test.
+
+The gate's value is its exit-code contract — 0 = no regression,
+nonzero naming the offending metric — so that contract is what the
+tests pin, metric by metric, plus the tolerance-from-pyproject loading
+and the skip-don't-fail stance on keys only one round carries (older
+artifacts predate newer bench keys; that must never fail the gate).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "bench_compare.py",
+)
+_spec = importlib.util.spec_from_file_location("bench_compare", _TOOL)
+bc = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bc)
+
+TOL = dict(bc.DEFAULT_TOLERANCES)
+
+
+def make_round(**overrides) -> dict:
+    rec = {
+        "metric": "T_solver 100x200 (42 PCG iters to 1e-6), f32, 1 chip",
+        "value": 0.5,
+        "valid": True,
+        "grids": [
+            {"grid": [100, 200], "t_solver_s": 0.5, "iters": 42,
+             "converged": True, "engine": "resident", "hbm_gbps": 100.0},
+            {"grid": [400, 600], "t_solver_s": 1.0, "iters": 99,
+             "converged": True, "engine": "xl", "hbm_gbps": 200.0},
+        ],
+        "config2": {"grid": [64, 64], "t_solver_s": 0.01, "iters": 7},
+        "f64": {"grid": [100, 200], "t_solver_s": 3.0, "iters": 42},
+        "spectrum": [
+            {"grid": [100, 200], "kappa": 5000.0, "predicted_iters": 42},
+        ],
+        "throughput": [
+            {"grid": [100, 200], "lanes": 8, "solves_per_sec": 50.0},
+        ],
+    }
+    rec.update(overrides)
+    return rec
+
+
+def regressions_between(old, new):
+    regs, _notes = bc.compare(old, new, TOL)
+    return [(r.metric, r.where) for r in regs]
+
+
+# ------------------------------------------------------- per-metric gates
+
+
+def test_identical_rounds_have_no_regressions():
+    rec = make_round()
+    assert regressions_between(rec, rec) == []
+
+
+def test_t_solver_regression_is_named_per_grid():
+    new = make_round()
+    new["grids"][0]["t_solver_s"] = 0.5 * (1 + TOL["t-solver-pct"]) * 1.01
+    assert regressions_between(make_round(), new) == [
+        ("t_solver_s", "100x200")
+    ]
+    # within tolerance: silent
+    new["grids"][0]["t_solver_s"] = 0.5 * (1 + TOL["t-solver-pct"]) * 0.99
+    assert regressions_between(make_round(), new) == []
+    # getting FASTER is never a regression
+    new["grids"][0]["t_solver_s"] = 0.1
+    assert regressions_between(make_round(), new) == []
+
+
+def test_iters_regression_is_absolute():
+    new = make_round()
+    new["grids"][1]["iters"] = 99 + int(TOL["iters-abs"]) + 1
+    assert regressions_between(make_round(), new) == [("iters", "400x600")]
+    new["grids"][1]["iters"] = 99 + int(TOL["iters-abs"])  # the ±2 reorder
+    assert regressions_between(make_round(), new) == []
+
+
+def test_scalar_row_keys_are_gated_too():
+    new = make_round()
+    new["f64"]["t_solver_s"] = 3.0 * 2
+    assert regressions_between(make_round(), new) == [("t_solver_s", "f64")]
+
+
+def test_gbps_drop_and_kappa_drift_are_regressions():
+    new = make_round()
+    new["grids"][0]["hbm_gbps"] = 100.0 * (1 - TOL["gbps-pct"]) * 0.9
+    assert regressions_between(make_round(), new) == [
+        ("hbm_gbps", "100x200")
+    ]
+    # kappa drifts BOTH ways: the operator didn't change, the estimator did
+    for factor in (1 + TOL["kappa-pct"] * 1.5, 1 - TOL["kappa-pct"] * 1.5):
+        new = make_round()
+        new["spectrum"][0]["kappa"] = 5000.0 * factor
+        assert regressions_between(make_round(), new) == [
+            ("kappa", "100x200")
+        ]
+    new = make_round()
+    new["spectrum"][0]["kappa"] = 5000.0 * (1 + TOL["kappa-pct"] * 0.5)
+    assert regressions_between(make_round(), new) == []
+
+
+def test_throughput_drop_is_a_regression():
+    new = make_round()
+    new["throughput"][0]["solves_per_sec"] = 50.0 * (1 - TOL["sps-pct"]) / 2
+    assert regressions_between(make_round(), new) == [
+        ("solves_per_sec", "100x200 lanes=8")
+    ]
+
+
+def test_null_kappa_in_a_matched_row_is_noted_not_silent():
+    # bench_spectrum writes kappa=null when the trace was unusable —
+    # exactly the broken-estimator case the gate exists to surface, so
+    # it must land in the notes even though both rounds carry the key
+    new = make_round()
+    new["spectrum"][0]["kappa"] = None
+    regs, notes = bc.compare(make_round(), new, TOL)
+    assert regs == []
+    assert any("kappa" in n and "100x200" in n for n in notes)
+
+
+def test_one_sided_keys_are_skipped_with_a_note_not_failed():
+    old = make_round()
+    del old["spectrum"]
+    del old["throughput"]
+    old["grids"] = old["grids"][:1]
+    for row in old["grids"]:
+        row.pop("hbm_gbps")
+    regs, notes = bc.compare(old, make_round(), TOL)
+    assert regs == []
+    text = " ".join(notes)
+    assert "spectrum" in text and "throughput" in text
+    assert "400x600" in text and "hbm_gbps" in text
+
+
+# --------------------------------------------------------- CLI contract
+
+
+def write_rounds(tmp_path, old, new):
+    po, pn = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    po.write_text(json.dumps({"parsed": old}))  # driver artifact form
+    pn.write_text(json.dumps(new))  # raw bench.py line form
+    return str(po), str(pn)
+
+
+def test_cli_exit_0_on_clean_and_1_with_named_metric(tmp_path, capsys):
+    po, pn = write_rounds(tmp_path, make_round(), make_round())
+    assert bc.main([po, pn]) == 0
+    assert "no regressions" in capsys.readouterr().out
+    slow = make_round()
+    slow["grids"][0]["t_solver_s"] = 5.0
+    po, pn = write_rounds(tmp_path, make_round(), slow)
+    assert bc.main([po, pn]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION t_solver_s @ 100x200" in out
+
+
+def test_cli_json_mode_carries_the_regression_list(tmp_path, capsys):
+    slow = make_round()
+    slow["grids"][0]["t_solver_s"] = 5.0
+    po, pn = write_rounds(tmp_path, make_round(), slow)
+    assert bc.main(["--json", po, pn]) == 1
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["regressions"][0]["metric"] == "t_solver_s"
+    assert rec["tolerances"]["t-solver-pct"] == TOL["t-solver-pct"]
+
+
+def test_cli_usage_and_unreadable_input_exit_2(tmp_path, capsys):
+    assert bc.main(["one.json"]) == 2  # one path is not a comparison
+    # unusable input is 2, NEVER 1: a gate reading 1 as "regression"
+    # must not misclassify a corrupt artifact as a slowdown
+    bad = tmp_path / "BENCH_r01.json"
+    bad.write_text("{not json")
+    assert bc.main([str(bad), str(bad)]) == 2
+    assert "cannot read" in capsys.readouterr().err
+    listy = tmp_path / "BENCH_r02.json"
+    listy.write_text("[1, 2]")
+    assert bc.main([str(listy), str(listy)]) == 2
+
+
+def test_newest_rounds_orders_by_round_number(tmp_path):
+    for name in ("BENCH_r9.json", "BENCH_r10.json", "BENCH_r2.json"):
+        (tmp_path / name).write_text("{}")
+    pair = [os.path.basename(p) for p in bc.newest_rounds(str(tmp_path))]
+    assert pair == ["BENCH_r9.json", "BENCH_r10.json"]
+
+
+def test_tolerances_load_from_pyproject_with_defaults(tmp_path):
+    # the repo's own pyproject overrides nothing surprising
+    repo_tol = bc.load_tolerances()
+    assert set(repo_tol) == set(bc.DEFAULT_TOLERANCES)
+    assert repo_tol["iters-abs"] == 2
+    # an explicit table overrides; the fallback parser stores floats as
+    # strings, so coercion is part of the contract under test
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.bench_compare]\nt-solver-pct = 0.5\niters-abs = 10\n"
+    )
+    tol = bc.load_tolerances(str(tmp_path))
+    assert tol["t-solver-pct"] == 0.5
+    assert tol["iters-abs"] == 10
+    assert tol["kappa-pct"] == bc.DEFAULT_TOLERANCES["kappa-pct"]
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.bench_compare]\nt-solver-pct = banana\n"
+    )
+    with pytest.raises(SystemExit, match="t-solver-pct"):
+        bc.load_tolerances(str(tmp_path))

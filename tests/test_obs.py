@@ -296,7 +296,7 @@ def test_trace_jsonl_roundtrips_and_validates(tmp_path):
     assert span["name"] == "phase:init" and span["dur"] >= 0
     assert span["fields"] == {"grid": "20x20"}
     assert records[3] == {
-        "v": 1, "run": run_id, "t": records[3]["t"],
+        "v": obs_trace.SCHEMA_VERSION, "run": run_id, "t": records[3]["t"],
         "kind": "counter", "name": "runs", "value": 1.0,
     }
 
@@ -304,6 +304,8 @@ def test_trace_jsonl_roundtrips_and_validates(tmp_path):
 def test_trace_validator_rejects_malformed_records():
     ok = {"v": 1, "run": "r1", "t": 0.5, "kind": "event", "name": "x"}
     assert obs_trace.validate_record(ok) is None
+    # v1 (pre-lane) records and v2 records both validate
+    assert obs_trace.validate_record({**ok, "v": 2}) is None
     bad = [
         ({**ok, "kind": "bogus"}, "kind"),
         ({k: v for k, v in ok.items() if k != "run"}, "run"),
@@ -313,11 +315,56 @@ def test_trace_validator_rejects_malformed_records():
         ({**ok, "kind": "span"}, "dur"),
         ({**ok, "kind": "gauge"}, "value"),
         ({**ok, "fields": [1]}, "fields"),
+        ({**ok, "lane": -1}, "lane"),
+        ({**ok, "lane": 1.5}, "lane"),
+        ({**ok, "lane": True}, "lane"),
         ("not a dict", "object"),
     ]
     for rec, needle in bad:
         err = obs_trace.validate_record(rec)
         assert err is not None and needle in err, (rec, err)
+
+
+def test_lane_addressed_events_validate_first_class(tmp_path):
+    """The batched driver's quarantine events carry ``lane`` as a
+    top-level schema key (v2), not a permissive fields poke — a lane
+    filter needs no JSON spelunking, and the validator checks it."""
+    ok = {"v": 2, "run": "r1", "t": 0.5, "kind": "event",
+          "name": "recovery:lane-quarantine", "lane": 3}
+    assert obs_trace.validate_record(ok) is None
+    path = tmp_path / "lane.jsonl"
+    obs_trace.start(path)
+    obs_trace.event("recovery:lane-quarantine", lane=2, detail="lane 2")
+    obs_trace.event("unaddressed")  # lane stays optional
+    obs_trace.stop()
+    assert obs_trace.validate_file(path) == []
+    recs = obs_trace.read_jsonl(path)
+    assert recs[1]["lane"] == 2 and "lane" not in recs[2]
+
+
+def test_batched_driver_emits_lane_on_quarantine_events(tmp_path):
+    from poisson_ellipse_tpu.batch import solve_batched
+    from poisson_ellipse_tpu.resilience import FaultPlan, inject_nan
+
+    problem = Problem(M=10, N=10)
+    path = tmp_path / "quarantine.jsonl"
+    obs_trace.start(path)
+    try:
+        guarded = solve_batched(
+            problem, 3, "batched", jnp.float32, chunk=4,
+            faults=FaultPlan(inject_nan(4, "r", lane=1)),
+        )
+    finally:
+        obs_trace.stop()
+    assert list(np.asarray(guarded.result.quarantined)) == [
+        False, True, False,
+    ]
+    assert obs_trace.validate_file(path) == []
+    quar = [
+        r for r in obs_trace.read_jsonl(path)
+        if r["name"] == "recovery:lane-quarantine"
+    ]
+    assert quar and quar[0]["lane"] == 1
 
 
 def test_trace_inactive_is_a_noop_and_env_activates(tmp_path, monkeypatch):
@@ -353,12 +400,143 @@ def test_metrics_registry_snapshot_and_kind_collisions():
     reg.counter("a").inc()
     reg.counter("a").inc(2)
     reg.gauge("b").set(7)
-    assert reg.snapshot() == {"counters": {"a": 3.0}, "gauges": {"b": 7.0}}
+    assert reg.snapshot() == {
+        "counters": {"a": 3.0}, "gauges": {"b": 7.0}, "histograms": {},
+    }
     with pytest.raises(ValueError, match="already a counter"):
         reg.gauge("a")
+    with pytest.raises(ValueError, match="already a counter"):
+        reg.histogram("a")
+    with pytest.raises(ValueError, match="already a gauge"):
+        reg.counter("b")
     with pytest.raises(ValueError, match="cannot decrease"):
         reg.counter("a").inc(-1)
     assert reg.gauge("unset") and reg.snapshot()["gauges"] == {"b": 7.0}
+
+
+def test_metrics_snapshot_is_name_sorted_not_creation_ordered():
+    reg = obs_metrics.MetricsRegistry()
+    for name in ("zeta", "alpha", "mid"):
+        reg.counter(name).inc()
+        reg.gauge(f"g_{name}").set(1)
+        reg.histogram(f"h_{name}").observe(0.5)
+    snap = reg.snapshot()
+    assert list(snap["counters"]) == ["alpha", "mid", "zeta"]
+    assert list(snap["gauges"]) == ["g_alpha", "g_mid", "g_zeta"]
+    assert list(snap["histograms"]) == ["h_alpha", "h_mid", "h_zeta"]
+
+
+def test_histogram_quantiles_and_window():
+    h = obs_metrics.Histogram("t")
+    assert h.quantile(0.5) is None
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.count == 100 and h.sum == 5050.0
+    assert h.quantile(0.5) == 51.0  # nearest-rank over the window
+    assert h.quantile(0.9) == 91.0
+    assert h.quantile(0.99) == 100.0
+    s = h.summary()
+    assert s["count"] == 100 and s["p50"] == 51.0 and s["p99"] == 100.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    # the window is bounded: count/sum stay lifetime totals
+    for v in range(obs_metrics.HISTOGRAM_WINDOW + 10):
+        h.observe(0.0)
+    assert h.count == 100 + obs_metrics.HISTOGRAM_WINDOW + 10
+    assert len(h._window) == obs_metrics.HISTOGRAM_WINDOW
+    assert h.quantile(0.99) == 0.0  # old observations aged out
+
+
+def test_metrics_emit_guards_closed_tracer_and_publishes_histograms(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    tracer = obs_trace.start(path)
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("runs").inc()
+    reg.histogram("lat").observe(2.0)
+    reg.emit(tracer)
+    obs_trace.stop()
+    # a late emit into the closed tracer is a no-op, not a ValueError
+    assert tracer.closed
+    reg.emit(tracer)
+    recs = obs_trace.read_jsonl(path)
+    assert obs_trace.validate_file(path) == []
+    names = {(r["kind"], r["name"]) for r in recs}
+    assert ("counter", "runs") in names
+    assert ("counter", "lat_count") in names
+    assert ("gauge", "lat_p50") in names and ("gauge", "lat_sum") in names
+
+
+# ---------------------------------------------------------- export
+
+
+def test_openmetrics_renders_and_roundtrips_through_validator():
+    from poisson_ellipse_tpu.obs import export as obs_export
+
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("runs").inc(3)
+    reg.gauge("last_iters").set(546)
+    for v in (0.001, 0.002, 0.004):
+        reg.histogram("solve_seconds").observe(v)
+    snap = reg.snapshot()
+    text = obs_export.render_openmetrics(snap)
+    assert obs_export.validate_openmetrics(text) == []
+    assert text.endswith("# EOF\n")
+    assert "# TYPE poisson_runs counter" in text
+    assert "poisson_runs_total 3" in text
+    assert 'poisson_solve_seconds{quantile="0.5"} 0.002' in text
+    parsed = obs_export.parse_openmetrics(text)
+    assert parsed["counters"] == {"poisson_runs": 3.0}
+    assert parsed["gauges"] == {"poisson_last_iters": 546.0}
+    hist = parsed["histograms"]["poisson_solve_seconds"]
+    assert hist["count"] == 3.0 and hist["p50"] == 0.002
+    # determinism: same registry renders byte-identically
+    assert obs_export.render_openmetrics(reg.snapshot()) == text
+
+
+def test_openmetrics_validator_rejects_malformed_expositions():
+    from poisson_ellipse_tpu.obs import export as obs_export
+
+    assert obs_export.validate_openmetrics("junk line\n# EOF\n")
+    assert obs_export.validate_openmetrics("# TYPE x counter\nx_total 1\n")
+    assert obs_export.validate_openmetrics(
+        "x_total 1\n# TYPE x counter\n# EOF\n"
+    )  # sample precedes its TYPE
+    assert obs_export.validate_openmetrics(
+        "# TYPE x counter\nx_total nan-ish\n# EOF\n"
+    )
+    assert obs_export.validate_openmetrics(
+        "# TYPE x counter\n# TYPE x counter\nx_total 1\n# EOF\n"
+    )
+    # odd metric names sanitize into the grammar instead of failing
+    assert obs_export.metric_name("95th %ile latency!", "p") == \
+        "p_95th__ile_latency_"
+
+
+def test_metrics_exporter_writes_atomic_snapshots(tmp_path):
+    from poisson_ellipse_tpu.obs import export as obs_export
+
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("writes").inc()
+    path = tmp_path / "metrics.prom"
+    exporter = obs_export.MetricsExporter(path, registry=reg)
+    assert exporter.write() == str(path)
+    text = path.read_text()
+    assert obs_export.validate_openmetrics(text) == []
+    assert "poisson_writes_total 1" in text
+    # no temp droppings next to the snapshot
+    assert [p.name for p in tmp_path.iterdir()] == ["metrics.prom"]
+    with pytest.raises(ValueError, match="interval_s"):
+        exporter.start()
+    # Event.wait(0) returns immediately: a zero cadence would busy-spin
+    with pytest.raises(ValueError, match="positive"):
+        obs_export.MetricsExporter(path, registry=reg, interval_s=0).start()
+    # periodic mode: the context manager flushes at exit at minimum
+    reg.counter("writes").inc()
+    with obs_export.MetricsExporter(
+        path, registry=reg, interval_s=30.0
+    ):
+        pass
+    assert "poisson_writes_total 2" in path.read_text()
 
 
 # ---------------------------------------------------------- PhaseTimer
@@ -489,3 +667,132 @@ def test_harness_trace_flag_end_to_end(tmp_path, capsys):
         assert expected in names, (expected, names)
     # the CLI closed its tracer: nothing ambient leaks into later runs
     assert obs_trace.active() is None
+
+
+def test_harness_metrics_flag_writes_openmetrics_snapshot(tmp_path, capsys):
+    from poisson_ellipse_tpu.harness.__main__ import main
+    from poisson_ellipse_tpu.obs import export as obs_export
+
+    path = tmp_path / "run.prom"
+    rc = main(["10", "10", "--mode", "single", "--metrics", str(path),
+               "--json"])
+    assert rc == 0
+    text = path.read_text()
+    assert obs_export.validate_openmetrics(text) == []
+    assert "poisson_runs_total 1" in text
+    assert "poisson_last_iters" in text
+    assert 'poisson_solve_seconds{quantile="0.5"}' in text
+
+
+# ------------------------------------------------------- golden corpus
+
+
+def test_trace_golden_corpus_from_a_batched_guarded_run(tmp_path):
+    """One recorded batched+guarded run exercising every event family
+    the schema carries — phase spans, recovery events (lane-addressed
+    quarantine included), cache hit/miss, a bench artifact — validated
+    record by record, so schema drift breaks loudly here instead of in
+    a consumer's dashboard."""
+    from poisson_ellipse_tpu.batch import solve_batched
+    from poisson_ellipse_tpu.harness.__main__ import main
+    from poisson_ellipse_tpu.resilience import FaultPlan, inject_nan
+    from poisson_ellipse_tpu.runtime.compile_cache import WarmPool
+
+    problem = Problem(M=10, N=10)
+    path = tmp_path / "corpus.jsonl"
+    # the harness CLI contributes the phase:*/run_report/counter records
+    rc = main(["10", "10", "--mode", "single", "--trace", str(path),
+               "--json"])
+    assert rc == 0
+    obs_trace.start(path)  # append the serving + resilience families
+    try:
+        pool = WarmPool()
+        pool.warmup("batched", (10, 10), jnp.float32, lanes=3)
+        pool.solve(problem, 3, "batched", jnp.float32)
+        solve_batched(
+            problem, 3, "batched", jnp.float32, chunk=4,
+            faults=FaultPlan(inject_nan(4, "r", lane=1)),
+        )
+        obs_trace.event(
+            "bench_artifact", metric="T_solver", value=0.001, valid=True
+        )
+    finally:
+        obs_trace.stop()
+
+    records = obs_trace.read_jsonl(path)
+    assert obs_trace.validate_file(path) == []
+    names = {r["name"] for r in records}
+    for expected in (
+        "phase:init", "phase:solver", "phase:finalize",  # phase:*
+        "recovery:lane-quarantine",                       # recovery:*
+        "cache:miss", "cache:hit",                        # cache:*
+        "bench_artifact", "run_report",
+    ):
+        assert expected in names, (expected, sorted(names))
+    lanes = [r for r in records if "lane" in r]
+    assert lanes and all(
+        isinstance(r["lane"], int) and r["lane"] >= 0 for r in lanes
+    )
+    kinds = {r["kind"] for r in records}
+    assert kinds == {"meta", "span", "event", "counter", "gauge"}
+
+
+# -------------------------------------------------------- diagnose CLI
+
+
+def test_harness_diagnose_subcommand(tmp_path, capsys):
+    from poisson_ellipse_tpu.harness.__main__ import main
+
+    metrics = tmp_path / "diag.prom"
+    trace = tmp_path / "diag.jsonl"
+    rc = main([
+        "diagnose", "xla", "--grid", "20x20", "--no-xla-cost",
+        "--metrics", str(metrics), "--trace", str(trace), "--json",
+    ])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    # the acceptance contract: diagnosing changes nothing
+    assert rep["bit_identical"] is True
+    assert rep["converged"] is True
+    spec = rep["spectrum"]
+    assert spec["available"] and spec["kappa"] > 1
+    assert spec["predicted_iters"] == rep["iters"]  # measured-exact replay
+    prof = rep["profile"]
+    assert prof["iters"] == rep["iters"]
+    assert prof["t_compile_s"] >= 0 and prof["t_solve_s"] > 0
+    assert prof["modeled_hbm_bytes_per_iter"] > 0
+    # exports validate: OpenMetrics file + schema-valid trace
+    from poisson_ellipse_tpu.obs import export as obs_export
+
+    assert obs_export.validate_openmetrics(metrics.read_text()) == []
+    assert "poisson_diagnose_kappa" in metrics.read_text()
+    assert obs_trace.validate_file(trace) == []
+    assert "diagnose_report" in {
+        r["name"] for r in obs_trace.read_jsonl(trace)
+    }
+
+    # human-readable form names the contract and the spectral story
+    rc = main(["diagnose", "xla", "--grid", "10x10", "--no-profile"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "BIT-IDENTICAL" in out and "kappa" in out
+
+    # engines that record no history are a curated error, not a traceback
+    assert main(["diagnose", "resident"]) == 2
+    assert "history" in capsys.readouterr().err
+    # ... as are a bad repeat (checked BEFORE any solve is paid for), a
+    # malformed grid, and a zero metrics cadence on the main prog
+    assert main(["diagnose", "xla", "--repeat", "0"]) == 2
+    assert "repeat" in capsys.readouterr().err
+    assert main(["diagnose", "xla", "--grid", "40by40"]) == 2
+    assert "error" in capsys.readouterr().err
+    assert main(["diagnose", "xla",
+                 "--metrics", "/nonexistent-dir/x.prom"]) == 2
+    assert "cannot write" in capsys.readouterr().err
+    assert main(["10", "10", "--metrics", "x.prom",
+                 "--metrics-interval", "0"]) == 2
+    assert "metrics-interval" in capsys.readouterr().err
+    # an unwritable --metrics path fails FAST with the curated exit-2,
+    # not a traceback out of the finally block after a paid-for solve
+    assert main(["10", "10", "--metrics", "/nonexistent-dir/x.prom"]) == 2
+    assert "cannot write" in capsys.readouterr().err

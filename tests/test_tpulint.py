@@ -27,8 +27,10 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_ten_rules():
-    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + ["TPU010"]
+def test_registry_has_all_eleven_rules():
+    assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
+        "TPU010", "TPU011",
+    ]
     for code, rule in RULES.items():
         assert rule.code == code
         assert rule.name and rule.summary
@@ -947,6 +949,139 @@ def test_tpu010_suppression_and_pyproject_knob():
 
     config = load_config()
     assert "warmup*" in config.aot_warmup_fns
+
+
+# -- TPU011: unfenced timing spans ------------------------------------------
+
+
+def test_tpu011_positive_unfenced_span_around_jit():
+    src = """
+        import time
+        import jax
+
+        solver = jax.jit(lambda x: x + 1)
+
+        def measure(x):
+            t0 = time.perf_counter()
+            out = solver(x)
+            return time.perf_counter() - t0
+    """
+    assert codes_of(src) == ["TPU011"]
+
+
+def test_tpu011_positive_factory_bound_and_aot_callables():
+    # names tuple-unpacked from a jit factory (build_*) and bound from a
+    # .lower().compile() chain both count as dispatchable
+    src = """
+        import time
+
+        def run(problem):
+            solver, args, engine = build_solver(problem)
+            t0 = time.monotonic()
+            r = solver(*args)
+            return time.monotonic() - t0
+    """
+    assert codes_of(src) == ["TPU011"]
+    aot = """
+        import time
+        import jax
+
+        compiled = jax.jit(f).lower(x).compile()
+        t0 = time.time()
+        out = compiled(x)
+        t = time.time() - t0
+    """
+    assert [c for c in codes_of(aot) if c == "TPU011"] == ["TPU011"]
+
+
+def test_tpu011_negative_fenced_spans():
+    # all three fence spellings silence the span: the configured wrapper
+    # (host-sync-fns — the TPU008 allowlist, reused), jax.block_until_ready,
+    # and the .block_until_ready() method
+    src = """
+        import time
+        import jax
+        from poisson_ellipse_tpu.utils.timing import fence
+
+        solver = jax.jit(lambda x: x + 1)
+
+        def wrapper(x):
+            t0 = time.perf_counter()
+            out = solver(x)
+            fence(out)
+            return time.perf_counter() - t0
+
+        def direct(x):
+            t0 = time.perf_counter()
+            out = solver(x)
+            jax.block_until_ready(out)
+            return time.perf_counter() - t0
+
+        def method(x):
+            t0 = time.perf_counter()
+            out = solver(x).block_until_ready()
+            return time.perf_counter() - t0
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu011_negative_host_only_and_deadline_patterns():
+    # a host-only bracket has nothing to fence; a deadline check reads a
+    # clock against a t0 *parameter* (the guard's _check_deadline shape) —
+    # no span opens in that scope, so no finding
+    src = """
+        import time
+        import jax
+
+        solver = jax.jit(lambda x: x + 1)
+
+        def host_only(xs):
+            t0 = time.time()
+            total = sum(xs)
+            return time.time() - t0
+
+        def deadline(timeout, t0, k):
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(k)
+
+        def dispatch_before_span(x):
+            out = solver(x)
+            t0 = time.perf_counter()
+            host = out is None
+            return time.perf_counter() - t0
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu011_suppression_and_fence_allowlist_config():
+    # the enqueue-is-the-measurement case carries an annotated disable;
+    # the fence allowlist is the TPU008 host-sync-fns knob, shared
+    src = """
+        import time
+        import jax
+
+        solver = jax.jit(lambda x: x + 1)
+
+        def enqueue_cost(x):
+            t0 = time.perf_counter()
+            out = solver(x)
+            return time.perf_counter() - t0  # tpulint: disable=TPU011 — enqueue IS the measurement
+    """
+    assert codes_of(src) == []
+    custom = """
+        import time
+        import jax
+
+        solver = jax.jit(lambda x: x + 1)
+
+        def measure(x):
+            t0 = time.perf_counter()
+            out = solver(x)
+            my_sync(out)
+            return time.perf_counter() - t0
+    """
+    assert codes_of(custom) == ["TPU011"]
+    assert codes_of(custom, host_sync_fns=("my_sync",)) == []
 
 
 def test_suppression_is_per_code_not_blanket():

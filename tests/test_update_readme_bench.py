@@ -118,6 +118,38 @@ def test_observability_fields_absent_is_supported(workspace):
     assert "psum/iteration" not in readme.read_text()
 
 
+def test_spectrum_table_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        spectrum=[
+            {"grid": [100, 200], "engine": "xla", "iters": 42,
+             "converged": True, "kappa": 5432.1, "cg_rate": 0.97325,
+             "iters_bound": 80, "predicted_iters": 42,
+             "predicted_err": 0.0, "stagnated": False},
+        ]
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Spectral diagnostics" in text
+    assert "| 100×200 | 5432 | 0.97325 | 80 | 42 (+0.0%) | 42 |" in text
+    assert "bench_compare" in text
+
+
+def test_spectrum_absent_or_failed_is_supported(workspace):
+    # pre-diagnostics artifacts lack the key; a failed row carries no
+    # kappa — neither renders the table
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    assert "Spectral diagnostics" not in readme.read_text()
+    artifact.write_text(json.dumps(make_artifact(
+        spectrum=[{"grid": [100, 200], "engine": "xla", "iters": 42,
+                   "converged": False}]
+    )))
+    urb.regenerate(str(readme), str(artifact))
+    assert "Spectral diagnostics" not in readme.read_text()
+
+
 def test_recovery_field_rendered_when_present(workspace):
     _tmp, readme, artifact = workspace
     rec = make_artifact(

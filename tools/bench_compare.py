@@ -1,0 +1,330 @@
+"""Threshold-based regression gate between two BENCH_r*.json rounds.
+
+Every perf PR gets one number story: the round driver archives
+`bench.py`'s JSON line as `BENCH_r{N}.json`, and this tool diffs any two
+rounds metric by metric against named tolerances, exiting nonzero with
+the offending metric spelled out — a perf regression becomes a failing
+check, not an archaeology project:
+
+    python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_compare.py              # newest two rounds
+
+Compared, where both rounds carry them (absence is skipped and noted —
+older artifacts predate newer keys, which must never fail the gate):
+
+- per-grid `t_solver_s` (grids / config2 / north_star / config4_1chip /
+  pipelined / f64 rows): slower than `t-solver-pct` is a regression
+- per-grid `iters`: growth beyond `iters-abs` (the oracle counts are
+  exact, so the default allows only the pipelined-style ±2 reordering)
+- per-grid `hbm_gbps` (grids rows, emitted since the diagnostics PR):
+  achieved bandwidth dropping more than `gbps-pct`
+- `spectrum` rows: `kappa` drifting more than `kappa-pct` in either
+  direction (same grid + same operator ⇒ same κ; a drift means the
+  trace or the estimator broke, not the hardware)
+- `throughput` rows (keyed grid × lanes): `solves_per_sec` dropping
+  more than `sps-pct`
+
+Tolerances live in `pyproject.toml [tool.bench_compare]` (shared by the
+CLI and the driver-dryrun smoke gate); built-in defaults apply when the
+table or a key is absent. Exit codes: 0 = no regression, 1 = regression
+(each named on stdout as `REGRESSION <metric> @ <where>: old -> new`),
+2 = unusable input.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fractional unless -abs; overridable via [tool.bench_compare]
+DEFAULT_TOLERANCES = {
+    "t-solver-pct": 0.25,
+    "iters-abs": 2,
+    "gbps-pct": 0.25,
+    "kappa-pct": 0.20,
+    "sps-pct": 0.25,
+}
+
+# scalar-row artifact keys carrying {grid, t_solver_s, iters}
+ROW_KEYS = (
+    "config2", "north_star", "config4_1chip", "pipelined", "f64",
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_tolerances(root: str = ROOT) -> dict:
+    """DEFAULT_TOLERANCES overlaid with `[tool.bench_compare]`.
+
+    Reuses the tpulint loader's tomllib-with-subset-fallback reader
+    (this interpreter may predate tomllib); the fallback stores floats
+    as strings, so values are coerced here.
+    """
+    tol = dict(DEFAULT_TOLERANCES)
+    pyproject = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pyproject):
+        return tol
+    try:
+        from poisson_ellipse_tpu.lint import _read_pyproject
+
+        table = _read_pyproject(pyproject).get("tool", {}).get(
+            "bench_compare", {}
+        )
+    except Exception:  # loader unavailable: the defaults still gate
+        return tol
+    for key in tol:
+        if key in table:
+            try:
+                tol[key] = float(table[key])
+            except (TypeError, ValueError):
+                raise SystemExit(
+                    f"[tool.bench_compare] {key} = {table[key]!r} is not "
+                    "a number"
+                )
+    return tol
+
+
+def _round_key(path: str) -> tuple[int, float]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    n = int(m.group(1)) if m else -1
+    return n, os.path.getmtime(path)
+
+
+def newest_rounds(root: str = ROOT, n: int = 2) -> list[str]:
+    """The n highest-round BENCH_r*.json paths, oldest first."""
+    rounds = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                    key=_round_key)
+    return rounds[-n:]
+
+
+def load_round(path: str) -> dict:
+    """One bench record (driver `{"parsed": ...}` or raw bench line)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise SystemExit(f"cannot read bench round {path}: {e}")
+    rec = data.get("parsed", data) if isinstance(data, dict) else data
+    if not isinstance(rec, dict):
+        raise SystemExit(f"{path}: not a bench record")
+    return rec
+
+
+class Regression:
+    """One named threshold violation."""
+
+    def __init__(self, metric: str, where: str, old, new, limit: str):
+        self.metric = metric
+        self.where = where
+        self.old = old
+        self.new = new
+        self.limit = limit
+
+    def __str__(self) -> str:
+        return (
+            f"REGRESSION {self.metric} @ {self.where}: "
+            f"{self.old:g} -> {self.new:g} ({self.limit})"
+        )
+
+
+def _by_grid(rows) -> dict:
+    out = {}
+    for row in rows or []:
+        grid = row.get("grid")
+        if grid:
+            out[tuple(grid)] = row
+    return out
+
+
+def _grid_label(key) -> str:
+    return "x".join(str(k) for k in key) if isinstance(key, tuple) else str(key)
+
+
+def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str]]:
+    """(regressions, notes) between two bench records.
+
+    Only metrics present on BOTH sides are judged; one-sided metrics
+    land in notes — a new bench key must not fail its first gated round,
+    and an old artifact must not fail for predating one.
+    """
+    regressions: list[Regression] = []
+    notes: list[str] = []
+
+    def one_sided(metric, where, o, n) -> bool:
+        """Note-and-skip when a metric exists on exactly one side of a
+        matched row — the 'absence is skipped and NOTED' half of the
+        contract (silent per-row absence would let a broken emitter
+        read as a clean gate)."""
+        if (o is None) != (n is None):
+            notes.append(f"{metric} @ {where}: only in one round, skipped")
+            return True
+        return False
+
+    def check_time(where, o, n):
+        if one_sided("t_solver_s", where, o, n):
+            return
+        limit = tol["t-solver-pct"]
+        if o and n is not None and n > o * (1.0 + limit):
+            regressions.append(Regression(
+                "t_solver_s", where, o, n,
+                f"+{(n / o - 1):.0%} > {limit:.0%} slower",
+            ))
+
+    def check_iters(where, o, n):
+        if one_sided("iters", where, o, n):
+            return
+        limit = tol["iters-abs"]
+        if o is not None and n is not None and n > o + limit:
+            regressions.append(Regression(
+                "iters", where, o, n, f"+{n - o} > +{limit:g} iterations",
+            ))
+
+    def scalar_rows(rec, key):
+        row = rec.get(key)
+        return row if isinstance(row, dict) and row.get("grid") else None
+
+    # the reference-grid table, matched per grid
+    old_grids = _by_grid(old.get("grids"))
+    new_grids = _by_grid(new.get("grids"))
+    for key in sorted(old_grids.keys() & new_grids.keys()):
+        o, n = old_grids[key], new_grids[key]
+        where = _grid_label(key)
+        check_time(where, o.get("t_solver_s"), n.get("t_solver_s"))
+        check_iters(where, o.get("iters"), n.get("iters"))
+        og, ng = o.get("hbm_gbps"), n.get("hbm_gbps")
+        if not one_sided("hbm_gbps", where, og, ng) and og and ng is not None:
+            limit = tol["gbps-pct"]
+            if ng < og * (1.0 - limit):
+                regressions.append(Regression(
+                    "hbm_gbps", where, og, ng,
+                    f"{(ng / og - 1):.0%} > {limit:.0%} bandwidth drop",
+                ))
+    for key in sorted(set(old_grids) ^ set(new_grids)):
+        notes.append(f"grid {_grid_label(key)}: only in one round, skipped")
+
+    # single-config rows
+    for key in ROW_KEYS:
+        o, n = scalar_rows(old, key), scalar_rows(new, key)
+        if o is None or n is None:
+            if (o is None) != (n is None):
+                notes.append(f"{key}: only in one round, skipped")
+            continue
+        check_time(key, o.get("t_solver_s"), n.get("t_solver_s"))
+        check_iters(key, o.get("iters"), n.get("iters"))
+
+    # spectral diagnostics: κ is a property of grid + operator, not of
+    # the hardware — drift EITHER way is a broken estimator/trace
+    old_spec = _by_grid(old.get("spectrum"))
+    new_spec = _by_grid(new.get("spectrum"))
+    for key in sorted(old_spec.keys() & new_spec.keys()):
+        ok, nk = old_spec[key].get("kappa"), new_spec[key].get("kappa")
+        if one_sided("kappa", _grid_label(key), ok, nk):
+            continue  # a null kappa IS the broken-estimator case: noted
+        if ok and nk is not None:
+            limit = tol["kappa-pct"]
+            if abs(nk - ok) > ok * limit:
+                regressions.append(Regression(
+                    "kappa", _grid_label(key), ok, nk,
+                    f"{(nk / ok - 1):+.0%} drift > ±{limit:.0%}",
+                ))
+    if bool(old.get("spectrum")) != bool(new.get("spectrum")):
+        notes.append("spectrum: only in one round, skipped")
+
+    # serving throughput, keyed grid × lanes
+    def by_grid_lanes(rows):
+        out = {}
+        for row in rows or []:
+            if row.get("grid") and row.get("lanes") is not None:
+                out[(tuple(row["grid"]), row["lanes"])] = row
+        return out
+
+    old_thr = by_grid_lanes(old.get("throughput"))
+    new_thr = by_grid_lanes(new.get("throughput"))
+    for key in sorted(old_thr.keys() & new_thr.keys()):
+        o = old_thr[key].get("solves_per_sec")
+        n = new_thr[key].get("solves_per_sec")
+        where_thr = f"{_grid_label(key[0])} lanes={key[1]}"
+        if one_sided("solves_per_sec", where_thr, o, n):
+            continue
+        if o and n is not None:
+            limit = tol["sps-pct"]
+            if n < o * (1.0 - limit):
+                regressions.append(Regression(
+                    "solves_per_sec", where_thr, o, n,
+                    f"{(n / o - 1):.0%} > {limit:.0%} throughput drop",
+                ))
+    if bool(old.get("throughput")) != bool(new.get("throughput")):
+        notes.append("throughput: only in one round, skipped")
+
+    return regressions, notes
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if len(argv) not in (0, 2):
+        print(
+            "usage: python tools/bench_compare.py [--json] "
+            "[OLD.json NEW.json]\n(no paths: the newest two BENCH_r*.json "
+            "rounds in the repo root)",
+            file=sys.stderr,
+        )
+        return 2
+    if argv:
+        old_path, new_path = argv
+    else:
+        rounds = newest_rounds()
+        if len(rounds) < 2:
+            print(
+                f"need two BENCH_r*.json rounds in {ROOT} to compare, "
+                f"found {len(rounds)}",
+                file=sys.stderr,
+            )
+            return 2
+        old_path, new_path = rounds
+    try:
+        tol = load_tolerances()
+        old, new = load_round(old_path), load_round(new_path)
+    except SystemExit as e:
+        # the exit-code contract: unusable input is 2, NEVER 1 — a CI
+        # gate reading 1 as "perf regression" must not misclassify a
+        # corrupt artifact or a typo'd tolerance as a slowdown
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    regressions, notes = compare(old, new, tol)
+    if as_json:
+        print(json.dumps({
+            "old": os.path.basename(old_path),
+            "new": os.path.basename(new_path),
+            "tolerances": tol,
+            "regressions": [
+                {
+                    "metric": r.metric, "where": r.where,
+                    "old": r.old, "new": r.new, "limit": r.limit,
+                }
+                for r in regressions
+            ],
+            "notes": notes,
+        }))
+    else:
+        print(
+            f"bench_compare: {os.path.basename(old_path)} -> "
+            f"{os.path.basename(new_path)}"
+        )
+        for note in notes:
+            print(f"  note: {note}")
+        for r in regressions:
+            print(f"  {r}")
+        if not regressions:
+            print("  no regressions (within tolerances)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

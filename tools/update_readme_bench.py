@@ -195,10 +195,53 @@ def table_block(rec: dict, src: str) -> str:
     obs = observability_lines(rec)
     if obs:
         lines += [""] + obs
+    spectrum = spectrum_lines(rec)
+    if spectrum:
+        lines += [""] + spectrum
     serving = serving_lines(rec)
     if serving:
         lines += [""] + serving
     return "\n".join(lines)
+
+
+def spectrum_lines(rec: dict) -> list[str]:
+    """Markdown for the artifact's ``spectrum`` key (emitted by bench.py
+    since the diagnostics layer landed): the κ-per-grid table with
+    predicted-vs-actual iterations. Pre-diagnostics artifacts lack the
+    key and render without the table; a failed row (no kappa — the
+    trace was unusable) is skipped, not a crash."""
+    rows = [
+        r for r in (rec.get("spectrum") or [])
+        if r.get("kappa") is not None and r.get("grid")
+    ]
+    if not rows:
+        return []
+    lines = [
+        "Spectral diagnostics (`obs.spectrum`: the Lanczos tridiagonal "
+        "hiding in the recorded CG α/β — κ(M⁻¹A) is what the iteration "
+        "counts *are*, and the yardstick preconditioner work is measured "
+        "against; κ drift between rounds is regression-gated by "
+        "`tools/bench_compare.py`):",
+        "",
+        "| Grid | κ(M⁻¹A) | CG rate | κ-bound iters | predicted | actual |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        M, N = r["grid"]
+        rate = f"{r['cg_rate']:.5f}" if r.get("cg_rate") is not None else "—"
+        bound = r.get("iters_bound")
+        pred = r.get("predicted_iters")
+        err = r.get("predicted_err")
+        pred_cell = (
+            f"{pred} ({err:+.1%})" if pred is not None and err is not None
+            else (str(pred) if pred is not None else "—")
+        )
+        lines.append(
+            f"| {M}×{N} | {r['kappa']:.4g} | {rate} | "
+            f"{bound if bound is not None else '—'} | {pred_cell} | "
+            f"{r.get('iters', '—')} |"
+        )
+    return lines
 
 
 def serving_lines(rec: dict) -> list[str]:
