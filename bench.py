@@ -29,10 +29,15 @@ checks):
                 gated between rounds by ``tools/bench_compare.py``.
   serving     — "throughput" key: aggregate solves/sec with the batched
                 engine at lanes ∈ {1, 8, 32} on 400×600 and the headline
-                grid (marginal-cost protocol; lane-0 oracle equality) and
+                grid (marginal-cost protocol; lane-0 oracle equality);
                 "coldstart" key: compile-vs-solve split with the AOT warm
                 pool off/on (the re-request must be a cache HIT —
-                ``runtime.compile_cache``'s no-recompile contract).
+                ``runtime.compile_cache``'s no-recompile contract); and
+                "serving" key: sustained solves/sec + p50/p99 latency
+                under a seeded Poisson arrival stream through the
+                continuous-batching scheduler (``serve.scheduler``,
+                chunk-boundary lane retire/refill) vs the static-batch
+                baseline — valid iff every request completes.
 """
 
 from __future__ import annotations
@@ -592,6 +597,82 @@ def bench_coldstart(grid: tuple[int, int] = (400, 600), lanes: int = 8):
     return row, ok
 
 
+def bench_serving(n_requests: int = 32, lanes: int = 4,
+                  grids=((40, 40), (48, 48)), seed: int = 0):
+    """The serving key: sustained solves/sec + latency quantiles under a
+    Poisson arrival stream, vs the static-batch baseline.
+
+    The continuous-batching scheduler (``serve.scheduler``) retires and
+    refills lanes at chunk boundaries, so a converged lane's slot goes
+    straight to the next queued request; the static baseline solves the
+    same request set in fixed ``lanes``-wide batches where every lane
+    waits for the slowest (PR 5's whole-batch semantics). Reported:
+    ``solves_per_sec`` for both disciplines plus the scheduler's
+    p50/p99 time-in-system. Validity = every request completed (zero
+    lost, zero unclassified) — the serving layer must never trade
+    correctness for the throughput number.
+    """
+    import random
+
+    import jax.numpy as jnp
+
+    from poisson_ellipse_tpu.batch.driver import solve_batched
+    from poisson_ellipse_tpu.serve import Scheduler
+
+    rng = random.Random(seed)
+    shapes = [rng.choice(list(grids)) for _ in range(n_requests)]
+
+    # continuous batching: seeded arrival stream through the scheduler
+    sched = Scheduler(lanes=lanes, chunk=32, queue_capacity=n_requests + 1,
+                      keep_solutions=False)
+    t0 = time.perf_counter()
+    for i, (M, N) in enumerate(shapes):
+        sched.submit(Problem(M=M, N=N), request_id=f"bench-{i:03d}")
+        sched.step()
+    results = sched.drain()
+    t_stream = time.perf_counter() - t0
+    lat = sorted(r.total_s for r in results.values())
+    completed = sum(1 for r in results.values() if r.outcome == "completed")
+    ok = completed == n_requests and len(results) == n_requests
+
+    # static baseline: same requests, fixed lanes-wide batches per shape
+    t0 = time.perf_counter()
+    for M, N in sorted(set(shapes)):
+        count = sum(1 for s in shapes if s == (M, N))
+        p = Problem(M=M, N=N)
+        done = 0
+        while done < count:
+            width = min(lanes, count - done)
+            static = solve_batched(p, width, "batched", jnp.float32,
+                                   chunk=1 << 30)
+            ok &= bool(static.result.converged.all())
+            done += width
+    t_static = time.perf_counter() - t0
+
+    def q(p):
+        return lat[min(int(p * len(lat)), len(lat) - 1)] if lat else None
+
+    row = {
+        "requests": n_requests,
+        "lanes": lanes,
+        "grids": [list(g) for g in grids],
+        "solves_per_sec": round(n_requests / t_stream, 3),
+        "static_solves_per_sec": round(n_requests / t_static, 3),
+        "latency_p50_s": round(q(0.50), 4) if lat else None,
+        "latency_p99_s": round(q(0.99), 4) if lat else None,
+        "completed": completed,
+        "valid": bool(ok),
+    }
+    note(
+        f"  [serving] {n_requests} requests over {sorted(set(shapes))} "
+        f"lanes={lanes}: continuous {row['solves_per_sec']} solves/s "
+        f"(p50 {row['latency_p50_s']}s, p99 {row['latency_p99_s']}s) vs "
+        f"static {row['static_solves_per_sec']} solves/s — "
+        + ("OK" if ok else "INCOMPLETE (regression)"),
+    )
+    return row, ok
+
+
 def bench_collectives():
     """Static collective accounting for the artifact: psum/ppermute per
     iteration read from the jaxpr (``obs.static_cost``) on a 1×2 mesh of
@@ -652,6 +733,9 @@ def main() -> int:
     # (f32, before the f64 flip below)
     thr_rows, okt = bench_throughput()
     cold_row, okcs = bench_coldstart()
+    # the continuous-batching front-end: sustained solves/sec + p50/p99
+    # under a Poisson arrival stream vs the static-batch baseline
+    serve_row, oksv = bench_serving()
     eps_rows, oke = bench_eps_sweep()
     # observability rows (f32, so they run before the f64 flip below):
     # on-device convergence telemetry + static collective accounting
@@ -664,7 +748,10 @@ def main() -> int:
     # resilience row: an injected NaN mid-solve must recover to oracle
     # parity through the guard (f32, before the f64 flip below)
     rec_row, okr = bench_recovery()
-    all_ok &= ok2 & okn & ok8 & okp & okt & okcs & oke & okc & okl & oks & okr
+    all_ok &= (
+        ok2 & okn & ok8 & okp & okt & okcs & oksv & oke & okc & okl & oks
+        & okr
+    )
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
     okf, f64_row = bench_f64_row()
@@ -691,6 +778,10 @@ def main() -> int:
         # compile-vs-solve split, warm pool off/on: cold-start latency
         # as its own regression-checked number (runtime.compile_cache)
         "coldstart": cold_row,
+        # continuous-batching serve layer: sustained solves/sec + p50/p99
+        # latency under a Poisson arrival stream vs static batching
+        # (serve.scheduler's retire-and-refill discipline)
+        "serving": serve_row,
         "eps_sweep": eps_rows,
         # on-device per-iteration telemetry summary (solve history=True)
         "convergence": conv_row,

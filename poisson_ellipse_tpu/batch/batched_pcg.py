@@ -76,12 +76,22 @@ def _lane_ops(a, b, mask):
     ``a``/``b`` may be (g1, g2) — shared geometry across lanes, the
     common serving case, which also saves their HBM passes — or
     (B, g1, g2) per-lane (mixed ε / mixed geometry). ``mask`` is an
-    optional (g1, g2) interior indicator for bucket-embedded problems.
+    optional interior indicator for bucket-embedded problems: (g1, g2)
+    shared, or (B, g1, g2) per-lane when lanes of one batch embed
+    *different* true shapes (the serve scheduler's mixed-shape packing).
     """
     a3 = a if a.ndim == 3 else a[None]
     b3 = b if b.ndim == 3 else b[None]
-    m3 = None if mask is None else mask[None]
+    m3 = None if mask is None else (mask if mask.ndim == 3 else mask[None])
     return a3, b3, m3
+
+
+def _grid_scale(h):
+    """``h`` as a lane-broadcastable grid factor: a scalar stays scalar
+    (the single-problem path, expression tree unchanged — the bitwise
+    contract); a (B,) per-lane spacing gains the (B, 1, 1) lane axis so
+    mixed-shape lanes each difference by their own h."""
+    return h if jnp.ndim(h) == 0 else h[:, None, None]
 
 
 def apply_a_batched(w, a3, b3, h1, h2):
@@ -89,8 +99,10 @@ def apply_a_batched(w, a3, b3, h1, h2):
 
     The expression tree mirrors ``ops.stencil.apply_a_block`` term for
     term (each difference divided by h before combining), so each lane's
-    result is bit-identical to the single-chip stencil's.
+    result is bit-identical to the single-chip stencil's. ``h1``/``h2``
+    may be scalars (shared spacing) or (B,) per-lane.
     """
+    h1, h2 = _grid_scale(h1), _grid_scale(h2)
     wc = w[:, 1:-1, 1:-1]
     ax = -(
         a3[:, 2:, 1:-1] * (w[:, 2:, 1:-1] - wc) / h1
@@ -106,7 +118,9 @@ def apply_a_batched(w, a3, b3, h1, h2):
 def diag_d_batched(a3, b3, h1, h2, mask=None):
     """Per-lane diagonal of A, zero boundary ring; ``mask`` additionally
     zeroes it outside an embedded true interior (bucket padding), which
-    makes ``apply_dinv`` pin those nodes to zero for free."""
+    makes ``apply_dinv`` pin those nodes to zero for free. ``h1``/``h2``
+    scalar or (B,) per-lane, as :func:`apply_a_batched`."""
+    h1, h2 = _grid_scale(h1), _grid_scale(h2)
     d = (a3[:, 2:, 1:-1] + a3[:, 1:-1, 1:-1]) / (h1 * h1) + (
         b3[:, 1:-1, 2:] + b3[:, 1:-1, 1:-1]
     ) / (h2 * h2)
@@ -172,6 +186,9 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, mask=None,
     ``mask`` a traced array: the bucket-generic executable of
     ``runtime.compile_cache`` is this function compiled once per padded
     shape, with every size-dependent number fed at dispatch.
+    ``h1``/``h2``/``delta`` may further be (B,) per-lane and ``mask``
+    (B, g1, g2) per-lane — the serve scheduler's mixed-shape packing,
+    where lanes of one bucket executable host different true problems.
 
     ``stencil="pallas"`` routes A·p through the batched Pallas kernel
     (lane dimension on the kernel grid, ``ops.pallas_kernels.

@@ -39,6 +39,18 @@ The serving surface:
   arbitrary request sizes hit a warm executable —
   ``python -m poisson_ellipse_tpu.harness warmup --grids 400x600
   --lanes 1,8 --engine both``.
+- ``serve`` drives a synthetic request stream through the
+  continuous-batching scheduler (``serve.scheduler``): seeded Poisson
+  arrivals of mixed shapes, bounded admission with backpressure,
+  deadlines at chunk granularity, lane retirement/refill, retry
+  ladder, optional crash-safe journal — ``python -m
+  poisson_ellipse_tpu.harness serve --requests 20 --grids 10x10,12x12
+  --deadline 5 --journal /tmp/journal.json``.
+- ``chaos`` is the serving chaos drill (``serve.chaos``): the same
+  stream with an injected NaN lane, a fake RESOURCE_EXHAUSTED and a
+  kill/restart with journal replay, asserting zero lost / zero
+  double-completed / all outcomes classified — ``python -m
+  poisson_ellipse_tpu.harness chaos --requests 50 --seed 0``.
 
 And the resilience surface:
 
@@ -53,7 +65,8 @@ And the resilience surface:
 - Exit codes are a contract: 0 converged, 1 iteration cap without
   convergence, 2 diverged (breakdown / recovery exhausted; also invalid
   invocations, per argparse convention), 3 device out-of-memory with no
-  engine left to degrade to, 4 ``--timeout`` exceeded.
+  engine left to degrade to, 4 ``--timeout`` exceeded, 5 shed at
+  admission by the serving layer (backpressure; retry after the hint).
 """
 
 from __future__ import annotations
@@ -81,7 +94,8 @@ EXIT_CODES_HELP = (
     "convergence; 2 diverged — breakdown or recovery budget exhausted "
     "(also invalid invocations, per argparse convention); 3 device "
     "out-of-memory with no engine left to degrade to; 4 --timeout "
-    "exceeded (partial trace artifact emitted)."
+    "exceeded (partial trace artifact emitted); 5 shed at admission by "
+    "the serving layer (backpressure — resubmit after retry_after_s)."
 )
 
 
@@ -597,6 +611,247 @@ def _run_warmup(argv: list[str]) -> int:
             obs_trace.stop()
 
 
+def _run_serve(argv: list[str]) -> int:
+    """The ``serve`` subcommand: a synthetic arrival stream through the
+    continuous-batching scheduler — the serving layer exercised from
+    the command line, lifecycle events on the trace, latency quantiles
+    in the report."""
+    import random
+    import time as _time
+
+    from poisson_ellipse_tpu.serve import Scheduler
+
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness serve",
+        description="Continuous-batching serve drill: drive a seeded "
+        "Poisson arrival stream of mixed shapes through the scheduler "
+        "(bounded admission, chunk-boundary lane retirement/refill, "
+        "deadlines, retry ladder, optional crash-safe journal). "
+        "exit code = the WORST per-request outcome of the stream "
+        "(numerically highest of the per-request contract): 0 every "
+        "request completed; 1 iteration cap; 2 failed/diverged (also "
+        "invalid invocations, per argparse convention); 4 deadline "
+        "missed; 5 shed at admission (backpressure — resubmit after "
+        "retry_after_s).",
+    )
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument(
+        "--grids", default="10x10,12x12",
+        help="comma list of MxN request shapes, mixed by the seeded RNG",
+    )
+    ap.add_argument(
+        "--rate", type=float, default=200.0,
+        help="Poisson arrival rate (requests/second of wall clock)",
+    )
+    ap.add_argument("--lanes", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument(
+        "--deadline", type=float, default=None, metavar="SECONDS",
+        help="per-request deadline (admission sheds infeasible asks; "
+        "mid-solve expiry cancels at a chunk boundary, partial result)",
+    )
+    ap.add_argument("--retries", type=int, default=1)
+    ap.add_argument(
+        "--journal", metavar="FILE",
+        help="crash-safe request journal; admitted-but-unfinished "
+        "requests replay on the next start (see --replay)",
+    )
+    ap.add_argument(
+        "--replay", action="store_true",
+        help="replay the journal's unfinished requests before the new "
+        "stream (requires --journal)",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
+    ap.add_argument(
+        "--metrics", metavar="FILE",
+        help="OpenMetrics snapshot of the serving counters/histograms",
+    )
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        obs_trace.start(args.trace)
+    try:
+        try:
+            if args.replay and not args.journal:
+                raise ValueError("--replay needs --journal")
+            grids = [_parse_grid(spec) for spec in args.grids.split(",")]
+            if args.requests < (0 if args.replay else 1):
+                # --requests 0 is the pure-replay restart: drain the
+                # journal's unfinished admissions, admit nothing new
+                raise ValueError(
+                    "--requests must be >= 1 (0 allowed with --replay)"
+                )
+            if args.rate <= 0:
+                raise ValueError("--rate must be > 0 requests/second")
+            sched = Scheduler(
+                lanes=args.lanes, chunk=args.chunk,
+                queue_capacity=args.queue_capacity,
+                dtype=resolve_dtype(args.dtype),
+                max_retries=args.retries, journal=args.journal,
+                keep_solutions=False,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        replayed = sched.replay() if args.replay else 0
+        rng = random.Random(args.seed)
+        t0 = _time.monotonic()
+        # results are harvested through collect() as the stream runs —
+        # the eviction hand-off a long-lived server needs (the
+        # scheduler's buffer stays bounded by the in-flight window)
+        results: dict = {}
+        for _ in range(args.requests):
+            M, N = rng.choice(grids)
+            sched.submit(
+                Problem(M=M, N=N), deadline_s=args.deadline,
+            )
+            _time.sleep(min(rng.expovariate(args.rate), 0.05))
+            sched.step()
+            results.update(sched.collect())
+        sched.drain()
+        results.update(sched.collect())
+        wall = _time.monotonic() - t0
+        counts: dict[str, int] = {}
+        for res in results.values():
+            counts[res.outcome] = counts.get(res.outcome, 0) + 1
+        completed = counts.get("completed", 0)
+        lat = obs_metrics.REGISTRY.histogram("time_in_queue_seconds")
+        record = {
+            "requests": args.requests,
+            "replayed": replayed,
+            "outcomes": counts,
+            "solves_per_sec": round(completed / wall, 2) if wall else None,
+            "queue_p50_s": lat.quantile(0.5),
+            "queue_p99_s": lat.quantile(0.99),
+            "wall_s": round(wall, 4),
+        }
+        obs_trace.event("serve_report", **record)
+        if args.metrics:
+            from poisson_ellipse_tpu.obs.export import MetricsExporter
+
+            err = MetricsExporter(args.metrics).try_write()
+            if err is not None:
+                print(
+                    f"warning: metrics snapshot failed: {err}",
+                    file=sys.stderr,
+                )
+        if args.json:
+            print(json.dumps(record))
+        else:
+            outcome_str = ", ".join(
+                f"{k}={v}" for k, v in sorted(counts.items())
+            )
+            print(
+                f"serve: {args.requests} requests (+{replayed} replayed) "
+                f"in {wall:.2f}s — {outcome_str}; "
+                f"{record['solves_per_sec']} solves/sec sustained"
+            )
+        # the documented contract: exit with the worst (numerically
+        # highest) per-request outcome, so a gate scripting on the
+        # help text classifies deadline misses and sheds as themselves
+        # rather than as convergence failures
+        from poisson_ellipse_tpu.serve import EXIT_BY_OUTCOME
+
+        return max((EXIT_BY_OUTCOME[o] for o in counts), default=0)
+    finally:
+        obs_metrics.REGISTRY.emit()
+        obs_metrics.REGISTRY.reset()
+        if args.trace:
+            obs_trace.stop()
+
+
+def _run_chaos(argv: list[str]) -> int:
+    """The ``chaos`` subcommand: the serving invariants under injected
+    lane NaN, fake OOM and a kill/restart — zero lost, zero
+    double-completed, every outcome classified."""
+    import os
+    import tempfile
+
+    from poisson_ellipse_tpu.serve import run_chaos
+
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness chaos",
+        description="Serving chaos drill (serve.chaos): a seeded Poisson "
+        "stream of mixed shapes with an injected NaN-poisoned lane, a "
+        "fake RESOURCE_EXHAUSTED dispatch, and one mid-stream "
+        "kill/restart with journal replay. Exit 0 iff zero requests "
+        "were lost, none double-completed, and every terminal state is "
+        "a classified outcome; exit 2 otherwise.",
+    )
+    ap.add_argument("--requests", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--grids", default="10x10,12x12,8x8")
+    ap.add_argument("--lanes", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument(
+        "--no-kill", action="store_true",
+        help="skip the kill/restart (fault injection only)",
+    )
+    ap.add_argument(
+        "--journal", metavar="FILE",
+        help="journal path (default: a temp file, removed after)",
+    )
+    ap.add_argument(
+        "--deadline", type=float, default=None, metavar="SECONDS",
+        help="per-request deadline for the stream",
+    )
+    ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        obs_trace.start(args.trace)
+    try:
+        try:
+            grids = tuple(
+                _parse_grid(spec) for spec in args.grids.split(",")
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        tmp_dir = None
+        journal = args.journal
+        if journal is None and not args.no_kill:
+            tmp_dir = tempfile.TemporaryDirectory()
+            journal = os.path.join(tmp_dir.name, "chaos-journal.json")
+        try:
+            report = run_chaos(
+                n_requests=args.requests, seed=args.seed, grids=grids,
+                lanes=args.lanes, chunk=args.chunk,
+                journal_path=journal,
+                kill_after=None if not args.no_kill else 0,
+                deadline_s=args.deadline,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        finally:
+            if tmp_dir is not None:
+                tmp_dir.cleanup()
+        if args.json:
+            print(json.dumps(report.json_dict()))
+        else:
+            verdict = "OK" if report.ok else "INVARIANT VIOLATION"
+            print(
+                f"chaos: {report.n_requests} requests, seed {args.seed} — "
+                f"{verdict}; outcomes {report.counts}; "
+                f"replayed {report.replayed} after kill; "
+                f"{report.faults_fired} faults fired; "
+                f"lost {len(report.lost)}, doubled "
+                f"{len(report.double_completed)} ({report.wall_s:.2f}s)"
+            )
+        return 0 if report.ok else 2
+    finally:
+        obs_metrics.REGISTRY.emit()
+        obs_metrics.REGISTRY.reset()
+        if args.trace:
+            obs_trace.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "inspect":
@@ -607,6 +862,10 @@ def main(argv=None) -> int:
         return _run_warmup(argv[1:])
     if argv and argv[0] == "diagnose":
         return _run_diagnose(argv[1:])
+    if argv and argv[0] == "serve":
+        return _run_serve(argv[1:])
+    if argv and argv[0] == "chaos":
+        return _run_chaos(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.harness",
         description="Fictitious-domain Poisson PCG on TPU",

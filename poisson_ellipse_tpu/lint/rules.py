@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU011.
+"""The tpulint rule registry: TPU001–TPU012.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -33,6 +33,10 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | the dispatch and the clock read — async       |
 |        |                    | dispatch means the bracket timed the queue,   |
 |        |                    | not the work                                  |
+| TPU012 | unbounded-queue    | a module/class-level list or deque grown by   |
+|        |                    | append with no maxlen and no draining bound — |
+|        |                    | a long-lived serving process's memory leak    |
+|        |                    | (the backpressure rule: bound it or shed)     |
 """
 
 from __future__ import annotations
@@ -1299,6 +1303,313 @@ def check_unfenced_timing(module: Module, config: LintConfig) -> Iterator[Findin
                 "suppress with a note if the enqueue itself is the "
                 "measurement",
             )
+
+
+# --------------------------------------------------------------------------
+# TPU012 — unbounded module/class-level queues in serving/driver code
+# --------------------------------------------------------------------------
+
+# container mutations that grow / that bound a queue-shaped binding
+_QUEUE_GROW = frozenset(
+    {"append", "appendleft", "extend", "extendleft", "insert"}
+)
+_QUEUE_BOUND = frozenset({"pop", "popleft", "clear", "remove"})
+
+
+def _queue_ctor(module: Module, node: ast.AST) -> Optional[str]:
+    """"list"/"deque" when ``node`` constructs an unbounded growable
+    container — ``[]``, ``list()``, ``deque(...)`` with no ``maxlen``,
+    or ``dataclasses.field(default_factory=list|deque)`` — else None.
+    A ``maxlen`` keyword (or deque's second positional) is the bound
+    and silences the rule at the source."""
+    if isinstance(node, ast.List) and not node.elts:
+        return "list"
+    if not isinstance(node, ast.Call):
+        return None
+    leaf = (module.qualname(node.func) or "").rsplit(".", 1)[-1]
+    if leaf == "list" and not node.args and not node.keywords:
+        return "list"
+    if leaf == "deque":
+        if len(node.args) >= 2 or any(
+            kw.arg == "maxlen" for kw in node.keywords
+        ):
+            return None
+        return "deque"
+    if leaf == "field":
+        for kw in node.keywords:
+            if (
+                kw.arg == "default_factory"
+                and isinstance(kw.value, ast.Name)
+                and kw.value.id in ("list", "deque")
+            ):
+                return kw.value.id
+    return None
+
+
+def _attr_is_self(node: ast.AST, attr: str) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == attr
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _shadowing_functions(root: ast.AST, name: str) -> set:
+    """Function subtrees within ``root`` where ``name`` is a *local* —
+    a parameter or a bare-name assignment target without a ``global``
+    declaration. Usage of the bare name inside them refers to the
+    local, not the module-level candidate, and must not be smeared
+    onto it (a local ``q.append`` is not a leak of the global ``q``,
+    and a local ``q.pop`` is not its bound)."""
+    shadowing: set = set()
+    for fn in ast.walk(root):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        a = fn.args
+        params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            params.append(a.vararg.arg)
+        if a.kwarg:
+            params.append(a.kwarg.arg)
+        rebinds = name in params
+        declared_global = False
+        # nested defs are classified on their own: prune their whole
+        # subtrees, not just the def node — ast.walk would keep yielding
+        # their bodies, smearing an inner local rebinding onto this
+        # function and silencing real growth in it
+        nested = {
+            n for n in ast.walk(fn)
+            if n is not fn
+            and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in _walk_excluding(fn, nested):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared_global |= name in node.names
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                rebinds |= any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in targets
+                )
+        if rebinds and not declared_global:
+            shadowing.add(fn)
+    return shadowing
+
+
+def _walk_excluding(root: ast.AST, exclude: set):
+    """``ast.walk`` that does not descend into the ``exclude`` nodes."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if node in exclude and node is not root:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _empty_container_expr(node: ast.AST) -> bool:
+    """An expression that builds a fresh empty container — the value
+    side of the swap-and-reset drain idiom (``out, q = q, []``)."""
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)) and not node.elts:
+        return True
+    if isinstance(node, ast.Dict) and not node.keys:
+        return True
+    if isinstance(node, ast.Call) and not node.args:
+        leaf = (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name)
+            else None
+        )
+        return leaf in ("list", "deque", "set", "dict")
+    return False
+
+
+def _queue_usage(scope: ast.AST, matches,
+                 exclude: set = frozenset(),
+                 defining: ast.AST | None = None) -> tuple[bool, bool]:
+    """(grows, bounded) for a candidate binding within ``scope``.
+    ``matches(expr)`` tests whether an expression references the
+    binding (a module-level name or a ``self.attr``); ``exclude``
+    subtrees (shadowing scopes) are not descended into. Bounds: any
+    shrinking method call, ``del q[...]``, a slice/index assignment
+    (the windowed-drain idiom), or a rebinding to a fresh empty
+    container (the swap-and-reset drain idiom) — ``defining`` is the
+    candidate's own initialiser, which must not count as that bound."""
+    grows = bounded = False
+    for node in _walk_excluding(scope, exclude):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if matches(node.func.value):
+                if node.func.attr in _QUEUE_GROW:
+                    grows = True
+                elif node.func.attr in _QUEUE_BOUND:
+                    bounded = True
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript) and matches(
+                    target.value
+                ):
+                    bounded = True
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            # pair each target with its value, unpacking same-length
+            # tuple assignments so `out, q = q, []` sees (q, [])
+            pairs: list[tuple[ast.AST, ast.AST]] = []
+            for target in targets:
+                if (
+                    isinstance(target, (ast.Tuple, ast.List))
+                    and isinstance(node.value, (ast.Tuple, ast.List))
+                    and len(target.elts) == len(node.value.elts)
+                ):
+                    pairs.extend(zip(target.elts, node.value.elts))
+                else:
+                    pairs.append((target, node.value))
+            for target, value in pairs:
+                if isinstance(target, ast.Subscript) and matches(
+                    target.value
+                ):
+                    bounded = True
+                elif (
+                    isinstance(node, ast.Assign)
+                    and node is not defining
+                    and matches(target)
+                    and _empty_container_expr(value)
+                ):
+                    bounded = True
+    return grows, bounded
+
+
+@rule(
+    "TPU012",
+    "unbounded-queue",
+    "module/class-level list or deque grown by append with no maxlen and "
+    "no draining bound — a long-lived serving process leaks memory",
+)
+def check_unbounded_queue(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """The backpressure rule, fenced structurally.
+
+    A request queue, event buffer or result list that lives at module
+    or instance scope and only ever grows is fine in a batch job and a
+    memory leak in a server: admission without a bound converts
+    overload into latency and then into an OOM kill (the failure mode
+    ``serve.queue`` exists to prevent — reject loudly with
+    ``retry_after`` instead of buffering forever). Candidates are
+    *long-lived* bindings only — module-level names and ``self``
+    attributes (including ``dataclasses.field(default_factory=list)``)
+    initialised to ``[]``/``list()``/``deque()`` without ``maxlen`` —
+    that some function then grows (``append``/``extend``/…). Function
+    locals are scoped to one call and stay silent. Any visible bound —
+    ``deque(maxlen=…)``, a shrinking call (``pop``/``popleft``/
+    ``clear``/``remove``), a ``del q[…]`` window trim, or a slice
+    assignment — silences the finding: the rule wants *a* bound, not a
+    particular one (``obs.metrics.Histogram``'s windowed ``del`` is the
+    house pattern)."""
+    # module-level names
+    for stmt in module.tree.body:
+        target = value = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+            isinstance(stmt.targets[0], ast.Name)
+        ):
+            target, value = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ) and stmt.value is not None:
+            target, value = stmt.target, stmt.value
+        if target is None:
+            continue
+        kind = _queue_ctor(module, value)
+        if kind is None:
+            continue
+        name = target.id
+
+        def matches(expr, name=name):
+            return isinstance(expr, ast.Name) and expr.id == name
+
+        grows, bounded = _queue_usage(
+            module.tree, matches,
+            exclude=_shadowing_functions(module.tree, name),
+            defining=stmt,
+        )
+        if grows and not bounded:
+            yield _finding(
+                module,
+                stmt,
+                "TPU012",
+                f"module-level {kind} `{name}` grows via append with no "
+                "bound: a long-lived serving process leaks memory here — "
+                "bound it (deque(maxlen=...), a windowed del, a drain) "
+                "or shed at admission (serve.queue's backpressure "
+                "contract)",
+            )
+    # class-level / instance attributes
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        candidates: dict[str, tuple[ast.AST, str]] = {}
+        for stmt in cls.body:
+            target = value = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+                isinstance(stmt.targets[0], ast.Name)
+            ):
+                target, value = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ) and stmt.value is not None:
+                target, value = stmt.target, stmt.value
+            if target is None:
+                continue
+            kind = _queue_ctor(module, value)
+            if kind is not None:
+                candidates[target.id] = (stmt, kind)
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                pairs = [(t, node.value) for t in node.targets]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                # `self.q: deque = deque()` — an annotation must not
+                # exempt the exact initialiser the rule exists to catch
+                pairs = [(node.target, node.value)]
+            else:
+                continue
+            for target, value in pairs:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    kind = _queue_ctor(module, value)
+                    if kind is not None and target.attr not in candidates:
+                        candidates[target.attr] = (node, kind)
+        for attr, (site, kind) in candidates.items():
+
+            def matches(expr, attr=attr, cls_name=cls.name):
+                # self.attr or ClassName.attr — a bare method-local
+                # name sharing the attribute's spelling is a different
+                # binding and must not be smeared onto it
+                return _attr_is_self(expr, attr) or (
+                    isinstance(expr, ast.Attribute)
+                    and expr.attr == attr
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == cls_name
+                )
+
+            grows, bounded = _queue_usage(cls, matches, defining=site)
+            if grows and not bounded:
+                yield _finding(
+                    module,
+                    site,
+                    "TPU012",
+                    f"instance-level {kind} `{attr}` of class "
+                    f"`{cls.name}` grows via append with no bound: every "
+                    "request leaves a residue a long-lived server never "
+                    "frees — bound it (deque(maxlen=...), a windowed del "
+                    "like obs.metrics.Histogram, a drain) or shed at "
+                    "admission",
+                )
 
 
 @rule(

@@ -80,6 +80,11 @@ def render_openmetrics(snapshot: dict, prefix: str = "poisson") -> str:
                 )
         lines.append(f"{full}_count {_num(summary.get('count', 0))}")
         lines.append(f"{full}_sum {_num(summary.get('sum', 0.0))}")
+        if summary.get("window") is not None:
+            # sliding-window occupancy: the staleness guard a scraper
+            # reads next to the quantiles (a stalled server's frozen p99
+            # shows a window that stops turning over with count)
+            lines.append(f"{full}_window {_num(summary['window'])}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -159,6 +164,8 @@ def parse_openmetrics(text: str) -> dict:
                 entry["count"] = value
             elif name.endswith("_sum"):
                 entry["sum"] = value
+            elif name.endswith("_window"):
+                entry["window"] = value
             else:
                 raise ValueError(
                     f"line {lineno}: unlabelled summary sample {name!r}"
@@ -172,7 +179,7 @@ def _family_of(sample_name: str, types: dict[str, str]):
     """(family base name, declared type) for one sample name."""
     if sample_name in types:
         return sample_name, types[sample_name]
-    for suffix in ("_total", "_count", "_sum"):
+    for suffix in ("_total", "_count", "_sum", "_window"):
         if sample_name.endswith(suffix):
             base = sample_name[: -len(suffix)]
             if base in types:

@@ -90,11 +90,29 @@ class Histogram:
         idx = min(int(q * len(ordered)), len(ordered) - 1)
         return ordered[idx]
 
+    @property
+    def window_occupancy(self) -> int:
+        """Observations currently in the sliding window.
+
+        The staleness guard: quantiles never age out by *time*, so a
+        stalled server keeps publishing the p99 of whenever it last did
+        work — identical, on the quantile samples alone, to a healthy
+        quiet one. Occupancy rides next to the quantiles (snapshot
+        ``window`` key, ``<name>_window`` OpenMetrics sample, a
+        ``<name>_window`` gauge in the trace) so a scraper can pair a
+        frozen p99 with a non-advancing lifetime ``count`` and flag the
+        stall instead of trusting the latency.
+        """
+        return len(self._window)
+
     def summary(self) -> dict:
-        """{"count", "sum", "p50", "p90", "p99"} — the snapshot entry."""
+        """{"count", "sum", "p50", "p90", "p99", "window"} — the
+        snapshot entry (``window`` = sliding-window occupancy, the
+        staleness guard next to the quantiles it qualifies)."""
         out = {"count": self.count, "sum": self.sum}
         for q in HISTOGRAM_QUANTILES:
             out[f"p{int(q * 100)}"] = self.quantile(q)
+        out["window"] = self.window_occupancy
         return out
 
 
@@ -181,6 +199,9 @@ class MetricsRegistry:
             # publish as gauges, the lifetime count as a counter
             tracer.emit("counter", f"{name}_count", value=summary["count"])
             tracer.emit("gauge", f"{name}_sum", value=summary["sum"])
+            # the staleness guard: window occupancy as its own gauge, so
+            # a frozen p99 is distinguishable from a healthy quiet one
+            tracer.emit("gauge", f"{name}_window", value=summary["window"])
             for q in HISTOGRAM_QUANTILES:
                 key = f"p{int(q * 100)}"
                 if summary[key] is not None:

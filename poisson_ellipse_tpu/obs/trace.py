@@ -31,6 +31,10 @@ Record schema (one JSON object per line; :func:`validate_record`):
   | lane   | no       | lane index of a lane-addressed event (the      |
   |        |          | batched engines' quarantine/fault records) —   |
   |        |          | first-class so lane filters need no field poke |
+  | request| no       | request id of a request-addressed event (the   |
+  | _id    |          | serve scheduler's admit/refill/retire/shed     |
+  |        |          | records) — first-class so one request's whole  |
+  |        |          | lifecycle greps out of a mixed stream          |
   | fields | no       | free-form JSON object of extra attributes      |
 
 Timing inside traced device loops is out of scope by design: a span is a
@@ -48,17 +52,21 @@ import time
 import uuid
 
 # v2 added the optional top-level ``lane`` key (lane-addressed batched
-# events); v1 records remain valid — see VALID_VERSIONS
-SCHEMA_VERSION = 2
+# events); v3 the optional ``request_id`` key (request-addressed serving
+# events); v1/v2 records remain valid — see VALID_VERSIONS
+SCHEMA_VERSION = 3
 
-VALID_VERSIONS = frozenset({1, 2})
+VALID_VERSIONS = frozenset({1, 2, 3})
 
 KINDS = frozenset({"meta", "span", "event", "counter", "gauge"})
 
 # the closed top-level key set: unknown keys fail validation so the
 # schema cannot grow silently (add here + bump SCHEMA_VERSION instead)
 _ALLOWED_KEYS = frozenset(
-    {"v", "run", "t", "kind", "name", "dur", "value", "lane", "fields"}
+    {
+        "v", "run", "t", "kind", "name", "dur", "value", "lane",
+        "request_id", "fields",
+    }
 )
 
 ENV_VAR = "POISSON_TRACE"
@@ -97,7 +105,8 @@ class Tracer:
 
     def emit(self, kind: str, name: str, dur: float | None = None,
              value: float | None = None, fields: dict | None = None,
-             t: float | None = None, lane: int | None = None) -> None:
+             t: float | None = None, lane: int | None = None,
+             request_id: str | None = None) -> None:
         if kind not in KINDS:
             raise ValueError(f"unknown record kind: {kind!r} (one of {sorted(KINDS)})")
         rec: dict = {
@@ -115,6 +124,8 @@ class Tracer:
             rec["value"] = value
         if lane is not None:
             rec["lane"] = int(lane)
+        if request_id is not None:
+            rec["request_id"] = str(request_id)
         if fields:
             rec["fields"] = fields
         # default=str: a numpy scalar or Path in a field must degrade to
@@ -122,8 +133,12 @@ class Tracer:
         self._fh.write(json.dumps(rec, default=str) + "\n")
         self._fh.flush()
 
-    def event(self, name: str, lane: int | None = None, **fields) -> None:
-        self.emit("event", name, fields=fields or None, lane=lane)
+    def event(self, name: str, lane: int | None = None,
+              request_id: str | None = None, **fields) -> None:
+        self.emit(
+            "event", name, fields=fields or None, lane=lane,
+            request_id=request_id,
+        )
 
     def span(self, name: str, **fields) -> "_Span":
         return _Span(self, name, fields)
@@ -243,10 +258,11 @@ def span_event(name: str, dur: float, **fields) -> None:
         )
 
 
-def event(name: str, lane: int | None = None, **fields) -> None:
+def event(name: str, lane: int | None = None,
+          request_id: str | None = None, **fields) -> None:
     tracer = active()
     if tracer:
-        tracer.event(name, lane=lane, **fields)
+        tracer.event(name, lane=lane, request_id=request_id, **fields)
 
 
 def note(message: str, file=None, _event: str = "note", **fields) -> None:
@@ -299,6 +315,10 @@ def validate_record(rec) -> str | None:
         lane = rec["lane"]
         if isinstance(lane, bool) or not isinstance(lane, int) or lane < 0:
             return "lane must be a non-negative integer"
+    if "request_id" in rec:
+        rid = rec["request_id"]
+        if not isinstance(rid, str) or not rid:
+            return "request_id must be a non-empty string"
     if "fields" in rec and not isinstance(rec["fields"], dict):
         return "fields must be an object"
     return None

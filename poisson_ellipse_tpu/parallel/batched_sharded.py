@@ -182,6 +182,96 @@ def build_batched_sharded_solver(
     return jax.jit(solver), args
 
 
+def build_sharded_chunk_advance(
+    bucket: tuple[int, int],
+    mesh: Mesh | None = None,
+    lanes: int | None = None,
+    norm: str = "weighted",
+    iter_ceiling: int = 1 << 30,
+):
+    """(jitted carry→carry chunk advance, proto problem) for the serve
+    scheduler's lane-refill loop composed with the mesh.
+
+    The refill machinery is host-side between-chunk work, so the traced
+    loop body is untouched: this is the classical batched lane step
+    (``batch.batched_pcg.make_lane_step`` — the identical per-lane
+    arithmetic) sharded whole-lanes-per-device, advancing an existing
+    carry up to a traced ``limit``. Per-lane operands, masks, spacings
+    and δ are traced arguments (the scheduler's mixed-shape packing),
+    so retire/refill/replay never retrace (the compute dtype rides on
+    the operands, not on a parameter here). The ONLY collective is the
+    convergence word — **exactly 1 psum per iteration**, lane-count- and
+    refill-invariant (jaxpr-pinned in ``tests/test_serve.py``).
+
+    Signature of the returned fn (matches the scheduler's single-device
+    bucket advance): ``fn(a3, b3, mask, h1, h2, delta, state, limit)``
+    where ``state`` is the classical batched carry and every per-lane
+    array is sharded on its lane axis.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    n_devices = mesh.shape[AXIS_X] * mesh.shape[AXIS_Y]
+    if lanes is None:
+        lanes = n_devices
+    if lanes % n_devices != 0:
+        raise ValueError(
+            f"lanes={lanes} must be a multiple of the mesh's {n_devices} "
+            "devices (whole lanes per device)"
+        )
+    proto = Problem(
+        M=bucket[0], N=bucket[1], norm=norm, max_iter=iter_ceiling
+    )
+    weighted = norm == "weighted"
+    lane3 = P(MESH_AXES, None, None)
+    lane1 = P(MESH_AXES)
+
+    def shard_fn(a3, b3, mask, h1, h2, delta, state, limit):
+        d = diag_d_batched(a3, b3, h1, h2, mask)
+        step = batched_pcg.make_lane_step(
+            a3, b3, d, mask, h1, h2, delta, weighted
+        )
+        bound = jnp.minimum(
+            limit, jnp.asarray(proto.max_iterations, jnp.int32)
+        )
+
+        def active_count(lane_state):
+            active = ~lane_state[6] & ~lane_state[7] & ~lane_state[8]
+            return lax.psum(jnp.sum(active, dtype=jnp.int32), MESH_AXES)
+
+        def cond(carry):
+            lane_state, n_active = carry
+            return (lane_state[0] < bound) & (n_active > 0)
+
+        def body(carry):
+            lane_state, _ = carry
+            new = step(lane_state)
+            # THE one collective of the iteration: the convergence word
+            return new, active_count(new)
+
+        out, _ = lax.while_loop(cond, body, (state, active_count(state)))
+        return out
+
+    state_specs = (
+        P(),                           # k — replicated global clock
+        lane3, lane3, lane3,           # w, r, p
+        lane1, lane1,                  # zr, diff
+        lane1, lane1, lane1, lane1,    # conv, bd, quar, iters
+    )
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            lane3, lane3, lane3, lane1, lane1, lane1, state_specs, P()
+        ),
+        out_specs=state_specs,
+    )
+
+    # no donation: the carry is re-read at every chunk boundary for the
+    # scheduler's retire/refill host work
+    # tpulint: disable=TPU004
+    return jax.jit(mapped), proto
+
+
 def solve_batched_sharded(
     problem: Problem,
     lanes: int | None = None,

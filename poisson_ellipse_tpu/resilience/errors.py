@@ -17,6 +17,9 @@ code the harness CLI contracts to return:
                                   degrade to
   4         SolveTimeout          ``--timeout`` deadline passed at a chunk
                                   boundary (partial trace artifact emitted)
+  5         AdmissionRejected     the serving layer shed the request at
+                                  admission (queue full / projected deadline
+                                  miss); carries ``retry_after_s``
   ========  ====================  ===========================================
 
 (exit 0 = converged, 1 = iteration cap reached without convergence — the
@@ -39,6 +42,7 @@ from __future__ import annotations
 EXIT_DIVERGED = 2
 EXIT_OOM = 3
 EXIT_TIMEOUT = 4
+EXIT_SHED = 5
 
 
 class SolveError(RuntimeError):
@@ -82,6 +86,22 @@ class SolveTimeout(SolveError):
 
     classification = "timeout"
     exit_code = EXIT_TIMEOUT
+
+
+class AdmissionRejected(SolveError):
+    """The serving layer refused the request at admission: the bounded
+    queue is full, or the projected wait already overruns the request's
+    deadline (``serve.queue``). This is backpressure, not failure — the
+    request was never dispatched and is safe to resubmit after
+    ``retry_after_s`` (the load-shedding contract: reject loudly now
+    rather than time out silently later)."""
+
+    classification = "shed"
+    exit_code = EXIT_SHED
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 # status phrasings XLA/Mosaic use for memory exhaustion, across runtime

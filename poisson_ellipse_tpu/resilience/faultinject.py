@@ -70,6 +70,15 @@ class Fault:
     the corruption lands on that lane's slice only, so the quarantine
     path is exercised against a batch whose other lanes stay healthy;
     ``None`` (single-solve carries) corrupts the whole field.
+    ``request_id`` addresses one *in-flight request* of the serve
+    scheduler (``serve.scheduler``) instead of a fixed lane index: the
+    scheduler resolves it to whichever lane currently hosts that
+    request at fire time (``at_iter`` counts the request's OWN
+    iterations, not the batch's global clock), so chaos tests can
+    poison a specific request across retirement/refill/retry without
+    knowing — or caring — where the scheduler packed it. Lane-addressed
+    consumers (``batch.driver``) reject request-addressed faults: a
+    fixed batch has no request table to resolve against.
     ``fired`` makes every fault one-shot — a replayed chunk after a
     recovery re-runs clean, which is what makes transient-fault recovery
     hit exact oracle parity. ``persistent=True`` re-fires on every visit
@@ -83,6 +92,7 @@ class Fault:
     field: str | None = None
     rows: int = 1
     lane: int | None = None
+    request_id: str | None = None
     fired: bool = False
     persistent: bool = False
 
@@ -93,6 +103,11 @@ class Fault:
             )
         if self.at_iter < 0:
             raise ValueError("at_iter must be >= 0")
+        if self.lane is not None and self.request_id is not None:
+            raise ValueError(
+                "a fault is addressed by lane OR by request_id, not both "
+                "(the scheduler resolves request_id to a lane at fire time)"
+            )
 
 
 def inject_nan(at_iter: int, field: str = "r",

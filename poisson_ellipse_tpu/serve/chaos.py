@@ -1,0 +1,197 @@
+"""Chaos harness: the serving invariants proven under injected failure.
+
+A serving layer's correctness claims are global, not per-request —
+*zero lost* (every admitted request reaches a terminal state, across
+kills), *zero double-completed* (no request finishes twice, across
+replays), *all classified* (every terminal state is one of the named
+outcomes). None of those can be unit-tested one code path at a time;
+they have to survive a hostile stream. This module drives one: a
+seeded Poisson arrival process of mixed shapes through the scheduler
+while ``resilience.faultinject`` poisons lanes (request-addressed NaN),
+fakes ``RESOURCE_EXHAUSTED`` on dispatch, and kills the server
+mid-stream — the restarted scheduler replays the journal and the
+stream keeps going. Everything is deterministic in ``seed``: the same
+chaos reproduces bit-for-bit, which is what makes a failing run
+debuggable instead of an anecdote.
+
+``run_chaos`` is the single entry shared by ``tests/test_serve.py``,
+the ``harness chaos`` subcommand, and the ``bench.py`` serving key's
+sanity half.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Optional, Sequence
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.resilience.faultinject import Fault, FaultPlan
+from poisson_ellipse_tpu.serve.journal import RequestJournal
+from poisson_ellipse_tpu.serve.request import OUTCOMES, ServeRequest
+from poisson_ellipse_tpu.serve.scheduler import Scheduler
+
+DEFAULT_GRIDS = ((10, 10), (12, 12), (8, 8))
+
+
+@dataclasses.dataclass
+class ChaosReport:
+    """One chaos run's verdict: the invariant booleans plus the
+    evidence behind them."""
+
+    n_requests: int
+    outcomes: dict            # request_id -> outcome
+    counts: dict              # outcome -> count
+    lost: list                # submitted ids with no terminal outcome
+    double_completed: list    # ids with >1 terminal outcome
+    unclassified: list        # ids whose outcome is not in OUTCOMES
+    replayed: int
+    killed: bool
+    faults_fired: int
+    wall_s: float
+
+    @property
+    def ok(self) -> bool:
+        return not (self.lost or self.double_completed or self.unclassified)
+
+    def json_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["ok"] = self.ok
+        return out
+
+
+def _chaos_id(i: int) -> str:
+    return f"chaos-{i:04d}"
+
+
+def run_chaos(
+    n_requests: int = 50,
+    seed: int = 0,
+    grids: Sequence[tuple[int, int]] = DEFAULT_GRIDS,
+    rate_per_s: float = 400.0,
+    lanes: int = 4,
+    chunk: int = 8,
+    queue_capacity: int = 128,
+    journal_path=None,
+    kill_after: Optional[int] = None,
+    nan_request: Optional[int] = 2,
+    oom_request: Optional[int] = 5,
+    deadline_s: Optional[float] = None,
+    max_retries: int = 2,
+) -> ChaosReport:
+    """Drive one seeded chaos stream; see the module docstring.
+
+    ``kill_after`` (default: ``n_requests // 2``) is the request index
+    after which the server is killed — the Scheduler object is dropped
+    with requests queued and in flight, exactly what SIGKILL leaves
+    behind — and a fresh scheduler on the same journal replays.
+    ``nan_request`` / ``oom_request`` pick which request indices get a
+    request-addressed NaN-poisoned lane and a fake RESOURCE_EXHAUSTED
+    (None disables either). Requires ``journal_path`` when a kill is
+    scheduled (the replay is the point).
+    """
+    if n_requests < 1:
+        raise ValueError("need at least one request")
+    if rate_per_s <= 0:
+        raise ValueError("rate_per_s must be > 0")
+    if kill_after is None:
+        kill_after = n_requests // 2
+    kill = kill_after is not None and 0 < kill_after < n_requests
+    if kill and journal_path is None:
+        raise ValueError(
+            "a kill/restart chaos run needs journal_path (replay is the "
+            "invariant under test)"
+        )
+    rng = random.Random(seed)
+    faults = []
+    if nan_request is not None and nan_request < n_requests:
+        faults.append(Fault(
+            "nan", at_iter=4, field="r", request_id=_chaos_id(nan_request),
+        ))
+    if oom_request is not None and oom_request < n_requests:
+        faults.append(Fault(
+            "oom", at_iter=2, request_id=_chaos_id(oom_request),
+        ))
+
+    def make_scheduler():
+        return Scheduler(
+            lanes=lanes, chunk=chunk, queue_capacity=queue_capacity,
+            max_retries=max_retries, backoff_base_s=0.001,
+            journal=(
+                RequestJournal(journal_path) if journal_path is not None
+                else None
+            ),
+            faults=FaultPlan(*faults),
+            keep_solutions=False,
+        )
+
+    t0 = time.monotonic()
+    sched = make_scheduler()
+    results: dict[str, object] = {}
+    completions_seen: dict[str, int] = {}
+
+    def harvest(s: Scheduler):
+        for rid, res in s.results.items():
+            if rid in results:
+                completions_seen[rid] = completions_seen.get(rid, 1) + 1
+            results[rid] = res
+
+    replayed = 0
+    # the arrival stream: exponential gaps, mixed shapes; between
+    # arrivals the scheduler keeps chewing chunks. Gaps are capped so a
+    # low rate cannot stall the harness; outcomes stay deterministic in
+    # the seed (arrival order and fault addressing are seed-driven, the
+    # sleep only paces the wall clock)
+    for i in range(n_requests):
+        if kill and i == kill_after:
+            # SIGKILL semantics: harvest what the dead server already
+            # finished (its journal has it), drop it mid-flight, replay
+            harvest(sched)
+            obs_trace.event("serve:chaos-kill", at_request=i)
+            sched = make_scheduler()
+            replayed = sched.replay()
+        time.sleep(min(rng.expovariate(rate_per_s), 0.01))
+        M, N = rng.choice(list(grids))
+        req = ServeRequest(
+            problem=Problem(M=M, N=N),
+            deadline=(
+                None if deadline_s is None
+                else sched.clock() + deadline_s
+            ),
+            max_retries=max_retries,
+        )
+        req.request_id = _chaos_id(i)
+        sched.submit_request(req)
+        # a couple of chunks between arrivals, like a busy server
+        sched.step()
+    sched.drain()
+    harvest(sched)
+
+    submitted = [_chaos_id(i) for i in range(n_requests)]
+    outcomes = {
+        rid: results[rid].outcome for rid in submitted if rid in results
+    }
+    lost = [rid for rid in submitted if rid not in outcomes]
+    unclassified = [
+        rid for rid, out in outcomes.items() if out not in OUTCOMES
+    ]
+    double = sorted(rid for rid, n in completions_seen.items() if n > 1)
+    counts: dict[str, int] = {}
+    for out in outcomes.values():
+        counts[out] = counts.get(out, 0) + 1
+    report = ChaosReport(
+        n_requests=n_requests,
+        outcomes=outcomes,
+        counts=counts,
+        lost=lost,
+        double_completed=double,
+        unclassified=unclassified,
+        replayed=replayed,
+        killed=kill,
+        faults_fired=sum(1 for f in faults if f.fired),
+        wall_s=time.monotonic() - t0,
+    )
+    obs_trace.event("serve:chaos-report", **report.json_dict())
+    return report

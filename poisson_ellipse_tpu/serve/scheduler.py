@@ -1,0 +1,819 @@
+"""Continuous-batching scheduler: retire-and-refill at chunk boundaries.
+
+PR 5's batched engines freeze a finished lane in place until the whole
+batch drains — fine for a fixed workload, wrong for a server, where a
+converged lane is a free slot someone is queueing for. This scheduler
+generalizes the in-loop freeze-out mask of ``batch.batched_pcg`` from
+*freeze* to *swap-in*: between chunks (the only place the host touches
+the carry anyway — the resilience chunk stance), a finished lane's
+slice of the carry is re-initialised with the next queued request's
+embedded operands, and the same compiled bucket executable keeps
+running — **no recompile**, because shapes are the only compile-time
+facts (every per-request number — h1, h2, δ, the mask, the RHS — is a
+traced operand, the ``runtime.compile_cache`` embedding made per-lane).
+This is Orca-style iteration-level scheduling (Yu et al., OSDI '22)
+with PCG chunks in place of decode steps.
+
+The robustness envelope around the packing loop:
+
+- **Admission** — bounded queue, backpressure, deadline-aware shedding
+  (``serve.queue``); every rejection carries ``retry_after_s``.
+- **Deadlines** — enforced at chunk granularity: expiry while queued is
+  shed un-dispatched; expiry mid-solve cancels at the chunk boundary
+  with a partial result (the ``run_report_partial`` stance per
+  request); a request that converges at the same boundary its deadline
+  passes gets its result (converged lanes retire *first* — no spurious
+  miss).
+- **Retries** — a per-request budget with exponential backoff walking
+  the degradation ladder: quarantined/broken lane → resubmit on a
+  fresh lane → guarded single solve (``resilience.guard``) as the final
+  rung; whatever the ladder ends in is a classified outcome.
+- **Durability** — a crash-safe request journal (``serve.journal``):
+  admissions are journaled before they are acknowledged, so a killed
+  server replays every admitted-but-unfinished request on restart.
+- **Observability** — every admission/refill/retirement/shed/retry/
+  replay is a request-addressed ``obs.trace`` event (schema v3) and an
+  ``obs.metrics`` counter/histogram (``queue_depth``,
+  ``time_in_queue_seconds``, ``deadline_miss_total``, ``shed_total``),
+  exported via the ``--metrics`` OpenMetrics path.
+
+Refill only targets the **classical** batched engine: a refilled lane
+must be bit-identical to the same request solved on a fresh lane
+(pinned in ``tests/test_batched.py``), and only the classical carry
+round-trips exactly through ``init_state`` — the pipelined recurrence
+seeds a multi-term history a mid-stream re-init would perturb.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.batch import batched_pcg
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import metrics as obs_metrics
+from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.resilience.errors import SolveError
+from poisson_ellipse_tpu.resilience.faultinject import Fault, FaultPlan
+from poisson_ellipse_tpu.runtime.compile_cache import grid_bucket
+from poisson_ellipse_tpu.serve.journal import RequestJournal
+from poisson_ellipse_tpu.serve.queue import AdmissionQueue
+from poisson_ellipse_tpu.serve.request import ServeRequest, ServeResult
+
+# the serve carry's global iteration ceiling: requests come and go, the
+# batch's clock only moves forward — per-request caps are enforced
+# host-side against each lane's swap-in offset
+ITER_CEILING = 1 << 30
+
+# classical batched carry layout (mirrors batch.driver._LAYOUT["batched"])
+_IDX = {
+    "k": 0, "w": 1, "r": 2, "p": 3, "zr": 4, "diff": 5,
+    "conv": 6, "bd": 7, "quar": 8, "iters": 9,
+}
+_FIELDS = {"w": 1, "r": 2, "p": 3, "zr": 4}
+
+DEFAULT_LANES = 4
+DEFAULT_CHUNK = 16
+
+
+@functools.lru_cache(maxsize=32)
+def _bucket_advance(Mb: int, Nb: int, dtype_name: str, norm: str):
+    """The bucket executable: ONE jitted chunk-advance per (bucket,
+    dtype, norm), shared by every scheduler in the process. Operands,
+    per-lane h/δ, masks, carry and bound are all traced arguments, so
+    retire/refill/replay never retrace — the TPU010 stance, per bucket.
+    """
+    proto = Problem(M=Mb, N=Nb, norm=norm, max_iter=ITER_CEILING)
+
+    def fn(a3, b3, mask, h1, h2, delta, state, limit):
+        # the rhs positional only supplies a dtype to advance(); the
+        # carry's own w plays that role here (rhs lives in r at init)
+        return batched_pcg.advance(
+            proto, a3, b3, state[1], state, limit=limit, mask=mask,
+            h1=h1, h2=h2, delta=delta,
+        )
+
+    # no donation: the carry is re-read at every boundary for the
+    # retire/refill host work
+    return jax.jit(fn), proto  # tpulint: disable=TPU004
+
+
+# no donation, matching _bucket_advance: the host re-reads the carry
+# at every boundary, and CPU/CI backends would only warn
+@jax.jit
+# tpulint: disable=TPU004
+def _refill_scatter(a3, b3, mask, h1, h2, delta, state, unit,
+                    a_p, b_p, m_p, h1v, h2v, dv, lane):
+    """One dispatch per refill: every operand slice and carry field of
+    the lane scattered together. The serving target regime is
+    dispatch-bound TPUs, where fifteen per-refill ``.at[].set`` round
+    trips would eat the continuous-batching win; ``lane`` is traced, so
+    shapes are the only compile keys (one build per bucket). Pure
+    copies — bit-identical to the unfused form by construction."""
+    a3 = a3.at[lane].set(a_p)
+    b3 = b3.at[lane].set(b_p)
+    mask = mask.at[lane].set(m_p)
+    h1 = h1.at[lane].set(h1v)
+    h2 = h2.at[lane].set(h2v)
+    delta = delta.at[lane].set(dv)
+    state = tuple(
+        s if i == _IDX["k"] else s.at[lane].set(u[0])
+        for i, (s, u) in enumerate(zip(state, unit))
+    )
+    return a3, b3, mask, h1, h2, delta, state
+
+
+def _embed_request(problem: Problem, bucket: tuple[int, int], np_dtype):
+    """Pad-and-mask one request into a bucket: zero-padded operands,
+    interior mask over the true problem (the ``runtime.compile_cache``
+    embedding, sliced per lane)."""
+    Mb, Nb = bucket
+    a, b, r = assembly.assemble_numpy(problem)
+    g1, g2 = problem.M + 1, problem.N + 1
+    pad2 = ((0, Mb + 1 - g1), (0, Nb + 1 - g2))
+    mask = np.zeros((Mb + 1, Nb + 1), np_dtype)
+    mask[1 : problem.M, 1 : problem.N] = 1.0
+    return (
+        np.pad(a, pad2).astype(np_dtype),
+        np.pad(b, pad2).astype(np_dtype),
+        np.pad(r, pad2).astype(np_dtype),
+        mask,
+    )
+
+
+class _InFlight:
+    """One dispatched request: which lane hosts it and at which global
+    iteration it swapped in (``base_k`` — per-request iteration counts
+    are ``iters[lane] - base_k``)."""
+
+    __slots__ = ("req", "lane", "base_k", "t_dispatch")
+
+    def __init__(self, req: ServeRequest, lane: int, base_k: int,
+                 t_dispatch: float):
+        self.req = req
+        self.lane = lane
+        self.base_k = base_k
+        self.t_dispatch = t_dispatch
+
+
+class _BatchCtx:
+    """One grid bucket's live batch: the compiled advance, the carry,
+    the per-lane operand stack, and the slot table."""
+
+    def __init__(self, bucket: tuple[int, int], lanes: int, dtype, norm: str,
+                 mesh=None):
+        self.bucket = bucket
+        self.norm = norm
+        if mesh is not None:
+            from poisson_ellipse_tpu.parallel.batched_sharded import (
+                build_sharded_chunk_advance,
+            )
+
+            self.fn, self.proto = build_sharded_chunk_advance(
+                bucket, mesh=mesh, lanes=lanes, norm=norm,
+                iter_ceiling=ITER_CEILING,
+            )
+        else:
+            self.fn, self.proto = _bucket_advance(
+                bucket[0], bucket[1], jnp.dtype(dtype).name, norm
+            )
+        g = (lanes, bucket[0] + 1, bucket[1] + 1)
+        zeros3 = jnp.zeros(g, dtype)
+        self.a3 = zeros3
+        self.b3 = zeros3
+        self.mask = zeros3
+        self.h1 = jnp.ones((lanes,), dtype)
+        self.h2 = jnp.ones((lanes,), dtype)
+        self.delta = jnp.full((lanes,), 1e-6, dtype)
+        state = list(batched_pcg.init_state(
+            self.proto, self.a3, self.b3, zeros3, mask=self.mask,
+            h1=self.h1, h2=self.h2,
+        ))
+        # every lane starts parked: the breakdown flag freezes it until
+        # a refill swaps a request in (zero-RHS lanes would otherwise
+        # burn one iteration reaching the same flag)
+        state[_IDX["bd"]] = jnp.ones((lanes,), bool)
+        self.state = tuple(state)
+        self.slots: list[Optional[_InFlight]] = [None] * lanes
+
+    @property
+    def active(self) -> bool:
+        return any(s is not None for s in self.slots)
+
+    def free_lane(self) -> Optional[int]:
+        for lane, slot in enumerate(self.slots):
+            if slot is None:
+                return lane
+        return None
+
+
+class Scheduler:
+    """The continuous-batching serve loop (see module docstring).
+
+    ``clock`` is injectable (monotonic seconds) so deadline semantics
+    are deterministically testable; ``idle`` is what ``drain`` calls
+    when every queued request is waiting out a retry backoff (default
+    ``time.sleep`` — pass the fake clock's ``advance`` in tests).
+    ``faults`` takes request-addressed injections
+    (``Fault(request_id=...)``); ``mesh`` routes the chunk advance
+    through the lane-sharded composition (1 psum/iter, jaxpr-pinned).
+    """
+
+    def __init__(
+        self,
+        lanes: int = DEFAULT_LANES,
+        chunk: int = DEFAULT_CHUNK,
+        queue_capacity: int = 64,
+        dtype=jnp.float32,
+        max_retries: int = 1,
+        backoff_base_s: float = 0.01,
+        journal: RequestJournal | str | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        idle: Callable[[float], None] = time.sleep,
+        faults: Optional[FaultPlan] = None,
+        keep_solutions: bool = True,
+        mesh=None,
+    ):
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1")
+        if lanes < 1:
+            raise ValueError("lanes must be >= 1")
+        self.lanes = lanes
+        self.chunk = chunk
+        self.dtype = dtype
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.clock = clock
+        self.idle = idle
+        self.faults = faults if faults is not None else FaultPlan()
+        self.keep_solutions = keep_solutions
+        self.mesh = mesh
+        self.journal = (
+            RequestJournal(journal) if isinstance(journal, (str, bytes))
+            or hasattr(journal, "__fspath__") else journal
+        )
+        self.queue = AdmissionQueue(queue_capacity, lanes, clock=clock)
+        self.results: dict[str, ServeResult] = {}
+        self._ctxs: dict[tuple, _BatchCtx] = {}
+        self._np_dtype = assembly.numpy_dtype(dtype)
+        # journaled requests recovered by replay() that exceeded queue
+        # capacity: fed back into the queue in waves as it drains —
+        # never terminally shed (the write-ahead promise outlives one
+        # queue's worth of backlog)
+        self._replay_backlog: list[ServeRequest] = []
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, problem: Problem, deadline_s: float | None = None,
+               max_retries: int | None = None,
+               request_id: str | None = None) -> Optional[ServeResult]:
+        """Admit one request. Returns ``None`` on acceptance, or the
+        terminal ``shed`` result (with ``retry_after_s``) when the
+        admission policy rejects it."""
+        req = ServeRequest(
+            problem=problem,
+            deadline=(
+                None if deadline_s is None else self.clock() + deadline_s
+            ),
+            max_retries=(
+                self.max_retries if max_retries is None else max_retries
+            ),
+        )
+        if request_id is not None:
+            req.request_id = request_id
+        return self.submit_request(req)
+
+    def submit_request(self, req: ServeRequest) -> Optional[ServeResult]:
+        prior = self.results.get(req.request_id)
+        if prior is not None and prior.outcome == "shed" and not prior.dispatched:
+            # shed-at-admission is "safe to resubmit after retry_after_s"
+            # (the request.py outcome table): the resubmission supersedes
+            # the rejection record instead of reading as a duplicate —
+            # nothing was journaled or dispatched, so nothing can double
+            del self.results[req.request_id]
+        if self._knows(req.request_id):
+            # a second live (or already-terminal) submission under the
+            # same id can never get its own outcome slot — refuse it at
+            # the door WITHOUT touching the original's lifecycle (no
+            # results entry, no journal write: recording it would
+            # overwrite or double-complete the first)
+            return ServeResult(
+                request_id=req.request_id, outcome="shed",
+                detail="duplicate-request-id",
+            )
+        accepted, retry_after, reason = self.queue.admit(req)
+        if not accepted:
+            result = ServeResult(
+                request_id=req.request_id, outcome="shed", detail=reason,
+                retry_after_s=retry_after,
+            )
+            self.results[req.request_id] = result
+            return result
+        if self.journal is not None:
+            # write-ahead: the admission is acknowledged only once the
+            # journal holds it; a failed journal write un-queues the
+            # request and surfaces the error instead of promising
+            # durability the disk refused
+            try:
+                self.journal.record_admit(req)
+            except BaseException:
+                self.queue.retract(req, "journal-write-failed")
+                raise
+        return None
+
+    def _knows(self, request_id: str) -> bool:
+        """Whether an id is already spoken for: queued, backlogged,
+        in flight, terminal in the result buffer, or journaled (a
+        collected-and-evicted result keeps its journal trail)."""
+        return (
+            request_id in self.results
+            or self.queue.holds(request_id)
+            or any(r.request_id == request_id for r in self._replay_backlog)
+            or self._slot_of(request_id) is not None
+            or (
+                self.journal is not None
+                and self.journal.state_of(request_id) is not None
+            )
+        )
+
+    def replay(self) -> int:
+        """Recover every journaled admitted-but-unfinished request (a
+        restarted server's first act). Requests beyond the bounded
+        queue's capacity wait in a replay backlog and re-enter in waves
+        as the queue drains — an acknowledged admission is never
+        terminally shed just because the restart arrived with more
+        backlog than one queue's worth (the write-ahead promise).
+        Returns the number of requests recovered."""
+        if self.journal is None:
+            raise ValueError("replay needs a journal-backed scheduler")
+        reqs = self.journal.unfinished(self.clock())
+        for req in reqs:
+            obs_trace.event(
+                "serve:replay", request_id=req.request_id,
+                grid=[req.problem.M, req.problem.N],
+            )
+        self._replay_backlog.extend(reqs)
+        self._admit_replay_wave()
+        return len(reqs)
+
+    def _admit_replay_wave(self) -> None:
+        """Move backlogged replay requests into the queue while it has
+        room. A request whose restarted deadline budget is already
+        infeasible ends ``deadline-miss`` — NOT ``shed``: shed means
+        "never admitted, safe to resubmit", and these were durably
+        acknowledged (a resubmit under the same id would be refused as
+        a duplicate). Capacity overflow is deferred, never terminal."""
+        while self._replay_backlog and len(self.queue) < self.queue.capacity:
+            req = self._replay_backlog.pop(0)
+            accepted, retry_after, reason = self.queue.admit(
+                req, record_shed=False
+            )
+            if not accepted:
+                self._finish_queued(
+                    req, "deadline-miss", detail=f"replay-{reason}",
+                    retry_after=retry_after,
+                )
+
+    # -- the serve loop ------------------------------------------------------
+
+    def step(self) -> bool:
+        """One chunk across every active bucket: shed expired queued
+        requests, refill free lanes, inject due faults, advance, retire.
+        Returns True while work remains (in flight or queued)."""
+        now = self.clock()
+        for req in self.queue.expire(now):
+            self._finish_queued(
+                req, "deadline-miss", detail="expired-in-queue"
+            )
+        self._admit_replay_wave()
+        self._fill_lanes()
+        # lanes just drained the queue — top it back up so the next
+        # boundary dispatches from a full line, not a replay-starved one
+        self._admit_replay_wave()
+        for ctx in list(self._ctxs.values()):
+            if not ctx.active:
+                continue
+            self._apply_faults(ctx)
+            if not ctx.active:
+                continue
+            k = int(ctx.state[0])
+            # the chunk stops early at the nearest per-request iteration
+            # cap (the FaultPlan.next_stop idiom): caps land exactly,
+            # not at the next multiple of `chunk`
+            limit_val = min(k + self.chunk, ITER_CEILING)
+            for slot in ctx.slots:
+                if slot is not None:
+                    limit_val = min(
+                        limit_val,
+                        slot.base_k + slot.req.problem.max_iterations,
+                    )
+            limit = jnp.asarray(max(limit_val, k + 1), jnp.int32)
+            ctx.state = ctx.fn(
+                ctx.a3, ctx.b3, ctx.mask, ctx.h1, ctx.h2, ctx.delta,
+                ctx.state, limit,
+            )
+            self._boundary(ctx)
+        return bool(
+            len(self.queue) or self._replay_backlog
+        ) or any(c.active for c in self._ctxs.values())
+
+    def drain(self, max_steps: int = 100_000) -> dict[str, ServeResult]:
+        """Step until every admitted request is terminal. When the only
+        remaining work is backoff-parked retries, waits them out via
+        ``idle``. ``max_steps`` is a runaway backstop, not a policy."""
+        steps = 0
+        while True:
+            in_flight = any(c.active for c in self._ctxs.values())
+            if (not in_flight and not len(self.queue)
+                    and not self._replay_backlog):
+                return self.results
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"drain exceeded {max_steps} steps with work pending"
+                )
+            progressed_work = self.step()
+            if progressed_work and not any(
+                c.active for c in self._ctxs.values()
+            ) and len(self.queue):
+                wait = self.queue.next_ready_in(self.clock())
+                if wait is not None:
+                    self.idle(wait)
+
+    def collect(self) -> dict[str, ServeResult]:
+        """Hand off and evict every terminal result recorded so far.
+
+        ``results`` (and the solution arrays it retains under
+        ``keep_solutions``) otherwise grows for the scheduler's
+        lifetime — the unbounded-memory failure mode the admission
+        queue exists to prevent, reintroduced at the exit. A long-lived
+        server must drain results through here (the ``harness serve``
+        loop does); ``drain()`` keeps returning the accumulated dict
+        for one-shot callers that read it after the stream ends."""
+        out = self.results
+        self.results = {}
+        return out
+
+    # -- refill --------------------------------------------------------------
+
+    def _ctx_for(self, req: ServeRequest) -> _BatchCtx:
+        bucket = grid_bucket(req.problem.M, req.problem.N)
+        key = (bucket, req.problem.norm)
+        ctx = self._ctxs.get(key)
+        if ctx is None:
+            ctx = _BatchCtx(
+                bucket, self.lanes, self.dtype, req.problem.norm,
+                mesh=self.mesh,
+            )
+            self._ctxs[key] = ctx
+        return ctx
+
+    def _fill_lanes(self) -> None:
+        now = self.clock()
+        deferred = []
+        while True:
+            req = self.queue.pop_ready(now)
+            if req is None:
+                break
+            ctx = self._ctx_for(req)
+            lane = ctx.free_lane()
+            if lane is None:
+                deferred.append(req)
+                continue
+            self._refill_lane(ctx, lane, req)
+        for req in reversed(deferred):
+            self.queue.push_front(req)
+
+    def _refill_lane(self, ctx: _BatchCtx, lane: int,
+                     req: ServeRequest) -> None:
+        """Swap a request into a free lane between chunks: embed its
+        operands into the lane's slices and re-initialise the lane's
+        carry from ``init_state`` — the freeze mask generalized to
+        swap-in. Per-lane arithmetic is lane-decoupled, so the refilled
+        lane's trajectory is bit-identical to a fresh lane-0 solve of
+        the same embedding (pinned in ``tests/test_batched.py``)."""
+        p = req.problem
+        a_p, b_p, r_p, m_p = _embed_request(p, ctx.bucket, self._np_dtype)
+        # the lane's fresh carry comes from the same eager init_state
+        # every other entry path uses (the bit-parity pin's reference);
+        # the scatter into the batch is one fused dispatch
+        unit = batched_pcg.init_state(
+            ctx.proto, jnp.asarray(a_p)[None], jnp.asarray(b_p)[None],
+            jnp.asarray(r_p)[None], mask=jnp.asarray(m_p)[None],
+            h1=p.h1, h2=p.h2,
+        )
+        (ctx.a3, ctx.b3, ctx.mask, ctx.h1, ctx.h2, ctx.delta,
+         ctx.state) = _refill_scatter(
+            ctx.a3, ctx.b3, ctx.mask, ctx.h1, ctx.h2, ctx.delta,
+            ctx.state, unit, a_p, b_p, m_p,
+            jnp.asarray(p.h1, ctx.h1.dtype), jnp.asarray(p.h2, ctx.h2.dtype),
+            jnp.asarray(p.delta, ctx.delta.dtype),
+            jnp.asarray(lane, jnp.int32),
+        )
+        base_k = int(ctx.state[_IDX["k"]])
+        now = self.clock()
+        ctx.slots[lane] = _InFlight(req, lane, base_k, now)
+        req.dispatched = True
+        if req.enqueued_t is not None:
+            obs_metrics.histogram("time_in_queue_seconds").observe(
+                now - req.enqueued_t
+            )
+        obs_metrics.counter("serve_refills_total").inc()
+        obs_trace.event(
+            "serve:refill", request_id=req.request_id, lane=lane,
+            base_k=base_k, attempt=req.attempt,
+            bucket=list(ctx.bucket),
+        )
+
+    def _park_lane(self, ctx: _BatchCtx, lane: int) -> None:
+        """Return a lane to the parked pool: zeroed state, breakdown
+        flag raised so the loop freezes it until the next refill."""
+        state = list(ctx.state)
+        for name in ("w", "r", "p"):
+            idx = _IDX[name]
+            state[idx] = state[idx].at[lane].set(
+                jnp.zeros(state[idx].shape[1:], state[idx].dtype)
+            )
+        state[_IDX["zr"]] = state[_IDX["zr"]].at[lane].set(0.0)
+        state[_IDX["conv"]] = state[_IDX["conv"]].at[lane].set(False)
+        state[_IDX["bd"]] = state[_IDX["bd"]].at[lane].set(True)
+        state[_IDX["quar"]] = state[_IDX["quar"]].at[lane].set(False)
+        ctx.state = tuple(state)
+        ctx.slots[lane] = None
+
+    # -- retirement ----------------------------------------------------------
+
+    def _boundary(self, ctx: _BatchCtx) -> None:
+        """The chunk-boundary host read: retire finished lanes.
+        Ordering is the deadline contract — converged lanes first (a
+        result beats a miss at the same boundary), then fault
+        retirement into the retry ladder, then deadline cancels, then
+        per-request iteration caps."""
+        conv = np.asarray(ctx.state[_IDX["conv"]])
+        bd = np.asarray(ctx.state[_IDX["bd"]])
+        quar = np.asarray(ctx.state[_IDX["quar"]])
+        iters = np.asarray(ctx.state[_IDX["iters"]])
+        diffs = np.asarray(ctx.state[_IDX["diff"]])
+        now = self.clock()
+        for lane, slot in enumerate(ctx.slots):
+            if slot is None:
+                continue
+            req = slot.req
+            req_iters = int(iters[lane]) - slot.base_k
+            diff = float(diffs[lane])
+            if conv[lane]:
+                self._finish(
+                    ctx, lane, slot, "completed", iters=req_iters,
+                    diff=diff, converged=True,
+                )
+            elif quar[lane] or bd[lane]:
+                cause = "lane-quarantine" if quar[lane] else "breakdown"
+                self._park_lane(ctx, lane)
+                self._retry_or_fallback(slot, cause)
+            elif req.deadline is not None and now > req.deadline:
+                self._finish(
+                    ctx, lane, slot, "deadline-miss", iters=req_iters,
+                    diff=diff, partial=True, detail="expired-mid-solve",
+                )
+            elif req_iters >= req.problem.max_iterations:
+                self._finish(
+                    ctx, lane, slot, "cap", iters=req_iters, diff=diff
+                )
+        # rebase the batch's global clock: k only moves forward, and a
+        # hot bucket on a long-lived server would otherwise walk it
+        # into ITER_CEILING (~2^30 iterations ≈ 2M solves) and wedge —
+        # limit could no longer exceed k, so no lane would ever advance
+        # or retire again. The shift is uniform across k / per-lane
+        # iters / slot base_k (iters tracks global k for active lanes),
+        # so every per-request count and cap is invariant under it.
+        if ctx.active:
+            base = min(s.base_k for s in ctx.slots if s is not None)
+        else:
+            base = int(ctx.state[_IDX["k"]])
+        if base > 0:
+            state = list(ctx.state)
+            state[_IDX["k"]] = state[_IDX["k"]] - base
+            state[_IDX["iters"]] = state[_IDX["iters"]] - base
+            ctx.state = tuple(state)
+            for s in ctx.slots:
+                if s is not None:
+                    s.base_k -= base
+
+    @staticmethod
+    def _span_s(req: ServeRequest, now: float) -> float:
+        """End-to-end seconds since the request's FIRST admission:
+        ``admitted_t`` survives retry requeues, which re-stamp
+        ``enqueued_t`` for the per-visit queue-wait histogram."""
+        anchor = (
+            req.admitted_t if req.admitted_t is not None else req.enqueued_t
+        )
+        return now - anchor if anchor is not None else 0.0
+
+    def _finish(self, ctx: _BatchCtx, lane: int, slot: _InFlight,
+                outcome: str, iters: int = 0, diff: float = float("inf"),
+                converged: bool = False, partial: bool = False,
+                detail: str | None = None) -> None:
+        req = slot.req
+        now = self.clock()
+        w = None
+        if self.keep_solutions and (converged or partial):
+            g1, g2 = req.problem.M + 1, req.problem.N + 1
+            w = np.asarray(ctx.state[_IDX["w"]][lane])[:g1, :g2].copy()
+        self._park_lane(ctx, lane)
+        self.queue.observe_service(now - slot.t_dispatch)
+        result = ServeResult(
+            request_id=req.request_id, outcome=outcome, iters=iters,
+            diff=diff, converged=converged, partial=partial,
+            dispatched=True, attempts=req.attempt + 1,
+            time_in_queue_s=(
+                slot.t_dispatch - req.enqueued_t
+                if req.enqueued_t is not None else 0.0
+            ),
+            total_s=self._span_s(req, now),
+            detail=detail, w=w,
+        )
+        self._record_terminal(result, lane=lane)
+
+    def _finish_queued(self, req: ServeRequest, outcome: str,
+                       detail: str | None = None,
+                       retry_after: float | None = None) -> None:
+        """Terminate a request while it is off-lane (queued expiry,
+        replay shed, a failed fallback). ``dispatched`` reports the
+        request's history, not this moment: a fresh expired-in-queue
+        request was never dispatched (the satellite contract), while a
+        retried or fallen-back one really did run on a lane first."""
+        now = self.clock()
+        result = ServeResult(
+            request_id=req.request_id, outcome=outcome,
+            dispatched=req.dispatched,
+            attempts=req.attempt,
+            time_in_queue_s=(
+                now - req.enqueued_t if req.enqueued_t is not None else 0.0
+            ),
+            total_s=self._span_s(req, now),
+            detail=detail, retry_after_s=retry_after,
+        )
+        self._record_terminal(result)
+
+    def _record_terminal(self, result: ServeResult,
+                         lane: int | None = None) -> None:
+        self.results[result.request_id] = result
+        if self.journal is not None:
+            self.journal.record_outcome(
+                result.request_id, result.outcome, detail=result.detail
+            )
+        if result.outcome == "deadline-miss":
+            obs_metrics.counter("deadline_miss_total").inc()
+        elif result.outcome == "completed":
+            obs_metrics.counter("serve_completed_total").inc()
+        obs_trace.event(
+            "serve:retire", request_id=result.request_id, lane=lane,
+            outcome=result.outcome, iters=result.iters,
+            attempts=result.attempts, partial=result.partial,
+            detail=result.detail,
+        )
+
+    # -- the retry ladder ----------------------------------------------------
+
+    def _retry_or_fallback(self, slot: _InFlight, cause: str) -> None:
+        """Walk the degradation ladder for a request whose lane went
+        bad: within budget, back off exponentially and resubmit on a
+        fresh lane; past it, fall to the guarded single solve — the
+        rung where the full recovery machinery of ``resilience.guard``
+        takes over. Every rung ends in a classified outcome."""
+        req = slot.req
+        req.attempt += 1
+        if req.attempt <= req.max_retries:
+            backoff = self.backoff_base_s * (2 ** (req.attempt - 1))
+            req.not_before = self.clock() + backoff
+            obs_metrics.counter("serve_retries_total").inc()
+            obs_trace.event(
+                "serve:retry", request_id=req.request_id, cause=cause,
+                attempt=req.attempt, backoff_s=round(backoff, 4),
+            )
+            if not self.queue.requeue(req):
+                self._finish_queued(
+                    req, "failed", detail="requeue-shed-under-overload"
+                )
+            return
+        self._guarded_fallback(req, cause)
+
+    def _guarded_fallback(self, req: ServeRequest, cause: str) -> None:
+        """The ladder's last rung: one guarded single solve of the true
+        (un-embedded) problem, with the remaining deadline budget as the
+        guard's timeout."""
+        from poisson_ellipse_tpu.resilience.guard import guarded_solve
+
+        # the fallback's dispatch instant: queue-wait accounting stops
+        # here — the solve itself must not read as time spent queued
+        t_dispatch = self.clock()
+        timeout = None
+        if req.deadline is not None:
+            timeout = req.deadline - t_dispatch
+            if timeout <= 0:
+                self._finish_queued(
+                    req, "deadline-miss",
+                    detail=f"expired-before-fallback ({cause})",
+                )
+                return
+        obs_trace.event(
+            "serve:fallback", request_id=req.request_id, cause=cause,
+            attempt=req.attempt,
+        )
+        try:
+            guarded = guarded_solve(
+                req.problem, "xla", self.dtype, chunk=self.chunk,
+                timeout=timeout,
+            )
+        except SolveError as e:
+            outcome = (
+                "deadline-miss" if e.classification == "timeout" else
+                "failed"
+            )
+            self._finish_queued(
+                req, outcome,
+                detail=f"guarded-fallback-{e.classification}",
+            )
+            return
+        result = guarded.result
+        now = self.clock()
+        res = ServeResult(
+            request_id=req.request_id,
+            outcome="completed" if bool(result.converged) else "cap",
+            iters=int(result.iters), diff=float(result.diff),
+            converged=bool(result.converged), dispatched=True,
+            attempts=req.attempt + 1,
+            time_in_queue_s=(
+                t_dispatch - req.enqueued_t
+                if req.enqueued_t is not None else 0.0
+            ),
+            total_s=self._span_s(req, now),
+            detail="guarded-fallback",
+            w=(
+                np.asarray(result.w).copy()
+                if self.keep_solutions and bool(result.converged) else None
+            ),
+        )
+        self._record_terminal(res)
+
+    # -- fault injection -----------------------------------------------------
+
+    def _slot_of(self, request_id: str):
+        for ctx in self._ctxs.values():
+            for slot in ctx.slots:
+                if slot is not None and slot.req.request_id == request_id:
+                    return ctx, slot
+        return None
+
+    def _apply_faults(self, ctx: _BatchCtx) -> None:
+        """Fire request-addressed faults due at this boundary.
+        ``at_iter`` counts the request's own iterations; injection lands
+        at the first chunk boundary at or past it (the chunk-granular
+        form of the guard's exact-iteration injection). ``oom`` is a
+        dispatch-level failure — the lane is freed and the request walks
+        the retry ladder; carry faults corrupt the lane slice and let
+        the in-loop quarantine detect them."""
+        if not self.faults:
+            return
+        iters = None
+        for fault in list(self.faults.faults):
+            if fault.fired or fault.request_id is None:
+                continue
+            located = self._slot_of(fault.request_id)
+            if located is None or located[0] is not ctx:
+                continue
+            _, slot = located
+            if iters is None:
+                iters = np.asarray(ctx.state[_IDX["iters"]])
+            req_iters = int(iters[slot.lane]) - slot.base_k
+            if req_iters < fault.at_iter:
+                continue
+            if not fault.persistent:
+                fault.fired = True
+            obs_trace.event(
+                "serve:fault", request_id=fault.request_id,
+                lane=slot.lane, kind=fault.kind, at_iter=fault.at_iter,
+            )
+            if fault.kind == "oom":
+                # what a real RESOURCE_EXHAUSTED on the dispatch looks
+                # like to the scheduler: the lane is lost, the request
+                # is not — straight onto the retry ladder
+                self._park_lane(ctx, slot.lane)
+                self._retry_or_fallback(slot, "oom")
+                continue
+            lane_fault = Fault(
+                fault.kind, at_iter=fault.at_iter, field=fault.field,
+                rows=fault.rows, lane=slot.lane,
+            )
+            from poisson_ellipse_tpu.resilience import faultinject
+
+            ctx.state = faultinject._corrupt(
+                list(ctx.state), lane_fault, _FIELDS, _IDX["bd"],
+                _IDX["zr"],
+            )

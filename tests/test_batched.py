@@ -90,6 +90,50 @@ def test_distinct_rhs_lanes_solve_their_own_problems(problem, single):
     )
 
 
+def test_refilled_lane_bit_identical_to_single_solve():
+    """The lane-refill correctness pin (ISSUE 7): a lane swapped in
+    MID-batch — nonzero global k, another lane still iterating — must
+    produce the bit-identical solution of the same request solved
+    single-lane. Per-lane arithmetic is lane-decoupled and k-independent,
+    so swap-in is bitwise-free exactly like lane packing at k=0."""
+    from poisson_ellipse_tpu.serve import Scheduler
+    from poisson_ellipse_tpu.solver.pcg import solve as pcg_solve
+
+    # 12x12 is bucket-exact (bucket_dim(12) == 12): no padding, so the
+    # embedded problem IS the problem and bitwise comparison is fair
+    p = Problem(M=12, N=12)
+    single = pcg_solve(p, jnp.float32)
+    sched = Scheduler(lanes=2, chunk=4)
+    # lane 0 hosts a longer request; lane 1's first tenant retires early
+    sched.submit(Problem(M=12, N=12, delta=1e-7), request_id="long")
+    sched.submit(Problem(M=12, N=12, delta=5e-6), request_id="short")
+    for _ in range(100):
+        sched.step()
+        if "short" in sched.results:
+            break
+    assert "short" in sched.results and "long" not in sched.results, (
+        "need a retirement while the other lane is still in flight"
+    )
+    sched.submit(p, request_id="swapped")
+    # dispatch at the next boundary, and read the swap-in offset BEFORE
+    # any chunk advance: retirements rebase the batch clock, so base_k
+    # is only meaningful at the moment of the swap-in itself
+    sched._fill_lanes()
+    located = sched._slot_of("swapped")
+    assert located is not None and located[1].base_k > 0, (
+        "the swap-in must happen mid-batch"
+    )
+    results = sched.drain()
+    res = results["swapped"]
+    assert res.outcome == "completed"
+    assert res.iters == int(single.iters)
+    assert float(res.diff) == float(single.diff)
+    assert bool(np.all(res.w == np.asarray(single.w))), (
+        "a refilled lane's solution must be bitwise identical to the "
+        "single-lane solve"
+    )
+
+
 # -- mixed-ε lanes -----------------------------------------------------------
 
 

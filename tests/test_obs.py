@@ -304,8 +304,9 @@ def test_trace_jsonl_roundtrips_and_validates(tmp_path):
 def test_trace_validator_rejects_malformed_records():
     ok = {"v": 1, "run": "r1", "t": 0.5, "kind": "event", "name": "x"}
     assert obs_trace.validate_record(ok) is None
-    # v1 (pre-lane) records and v2 records both validate
+    # v1 (pre-lane), v2 (lane) and v3 (request_id) records all validate
     assert obs_trace.validate_record({**ok, "v": 2}) is None
+    assert obs_trace.validate_record({**ok, "v": 3}) is None
     bad = [
         ({**ok, "kind": "bogus"}, "kind"),
         ({k: v for k, v in ok.items() if k != "run"}, "run"),
@@ -318,6 +319,8 @@ def test_trace_validator_rejects_malformed_records():
         ({**ok, "lane": -1}, "lane"),
         ({**ok, "lane": 1.5}, "lane"),
         ({**ok, "lane": True}, "lane"),
+        ({**ok, "request_id": ""}, "request_id"),
+        ({**ok, "request_id": 7}, "request_id"),
         ("not a dict", "object"),
     ]
     for rec, needle in bad:
@@ -340,6 +343,68 @@ def test_lane_addressed_events_validate_first_class(tmp_path):
     assert obs_trace.validate_file(path) == []
     recs = obs_trace.read_jsonl(path)
     assert recs[1]["lane"] == 2 and "lane" not in recs[2]
+
+
+def test_request_addressed_events_validate_first_class(tmp_path):
+    """The serve scheduler's lifecycle events carry ``request_id`` as a
+    top-level schema key (v3): one request's whole story — admit,
+    refill, retire, shed, retry, replay — greps out of a mixed stream
+    with no fields poke, and the validator checks the key's shape."""
+    ok = {"v": 3, "run": "r1", "t": 0.5, "kind": "event",
+          "name": "serve:admit", "request_id": "req-0001"}
+    assert obs_trace.validate_record(ok) is None
+    path = tmp_path / "request.jsonl"
+    obs_trace.start(path)
+    obs_trace.event("serve:admit", request_id="req-7", depth=3)
+    obs_trace.event("serve:refill", request_id="req-7", lane=1)
+    obs_trace.event("unaddressed")  # request_id stays optional
+    obs_trace.stop()
+    assert obs_trace.validate_file(path) == []
+    recs = obs_trace.read_jsonl(path)
+    assert recs[1]["request_id"] == "req-7"
+    # lane and request_id compose on one record (refill names both)
+    assert recs[2]["request_id"] == "req-7" and recs[2]["lane"] == 1
+    assert "request_id" not in recs[3]
+
+
+def test_histogram_window_occupancy_staleness_guard(tmp_path):
+    """The stalled-server guard (ISSUE 7 satellite): window occupancy
+    rides next to the quantiles in the summary, the OpenMetrics
+    rendering and the trace emit — so a frozen p99 with a full window
+    and a non-advancing count reads as a stall, not a quiet server."""
+    from poisson_ellipse_tpu.obs import export as obs_export
+
+    h = obs_metrics.Histogram("lat")
+    assert h.window_occupancy == 0
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    assert h.window_occupancy == 3
+    assert h.summary()["window"] == 3
+    # occupancy saturates at the window bound while count keeps moving
+    for _ in range(obs_metrics.HISTOGRAM_WINDOW + 5):
+        h.observe(0.0)
+    assert h.window_occupancy == obs_metrics.HISTOGRAM_WINDOW
+    assert h.count == 3 + obs_metrics.HISTOGRAM_WINDOW + 5
+
+    # OpenMetrics: the `<name>_window` sample renders inside the summary
+    # family and round-trips through the parser/validator
+    reg = obs_metrics.MetricsRegistry()
+    reg.histogram("solve_seconds").observe(0.5)
+    text = obs_export.render_openmetrics(reg.snapshot())
+    assert obs_export.validate_openmetrics(text) == []
+    assert "poisson_solve_seconds_window 1" in text
+    parsed = obs_export.parse_openmetrics(text)
+    assert parsed["histograms"]["poisson_solve_seconds"]["window"] == 1.0
+
+    # the trace emit publishes the occupancy gauge
+    path = tmp_path / "window.jsonl"
+    tracer = obs_trace.start(path)
+    reg.emit(tracer)
+    obs_trace.stop()
+    names = {
+        (r["kind"], r["name"]) for r in obs_trace.read_jsonl(path)
+    }
+    assert ("gauge", "solve_seconds_window") in names
 
 
 def test_batched_driver_emits_lane_on_quarantine_events(tmp_path):

@@ -27,9 +27,9 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_eleven_rules():
+def test_registry_has_all_twelve_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
-        "TPU010", "TPU011",
+        "TPU010", "TPU011", "TPU012",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -949,6 +949,226 @@ def test_tpu010_suppression_and_pyproject_knob():
 
     config = load_config()
     assert "warmup*" in config.aot_warmup_fns
+
+
+# -- TPU012: unbounded module/class-level queues ----------------------------
+
+
+def test_tpu012_positive_module_level_list_and_deque():
+    src = """
+        from collections import deque
+
+        PENDING = []
+        EVENTS = deque()
+
+        def enqueue(req):
+            PENDING.append(req)
+            EVENTS.appendleft(req)
+    """
+    assert codes_of(src) == ["TPU012", "TPU012"]
+
+
+def test_tpu012_positive_instance_queue_grown_in_method():
+    src = """
+        import collections
+
+        class Server:
+            def __init__(self):
+                self.queue = collections.deque()
+                self.log = []
+
+            def handle(self, req):
+                self.queue.append(req)
+                self.log.append(req.id)
+    """
+    assert codes_of(src) == ["TPU012", "TPU012"]
+
+
+def test_tpu012_positive_annotated_instance_queue():
+    # a type annotation on the initialiser must not exempt the exact
+    # unbounded-queue leak the rule exists to catch
+    src = """
+        import collections
+
+        class Server:
+            def __init__(self):
+                self.pending: list = []
+                self.events: collections.deque = collections.deque()
+
+            def handle(self, req):
+                self.pending.append(req)
+                self.events.append(req)
+    """
+    assert codes_of(src) == ["TPU012", "TPU012"]
+
+
+def test_tpu012_positive_dataclass_field_default_factory():
+    src = """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Buffer:
+            items: list = dataclasses.field(default_factory=list)
+
+            def push(self, x):
+                self.items.append(x)
+    """
+    assert codes_of(src) == ["TPU012"]
+
+
+def test_tpu012_negative_bounded_queues_stay_silent():
+    # maxlen at the source, a windowed del (the obs.metrics.Histogram
+    # idiom), and a draining pop each count as a bound
+    src = """
+        import collections
+        import dataclasses
+
+        RING = collections.deque(maxlen=64)
+
+        @dataclasses.dataclass
+        class Histogram:
+            _window: list = dataclasses.field(default_factory=list)
+
+            def observe(self, v):
+                self._window.append(v)
+                if len(self._window) > 10:
+                    del self._window[: len(self._window) - 10]
+
+        class Worker:
+            def __init__(self):
+                self.inbox = []
+
+            def put(self, x):
+                self.inbox.append(x)
+
+            def drain(self):
+                while self.inbox:
+                    self.inbox.pop()
+
+        def feed(x):
+            RING.append(x)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu012_negative_function_locals_stay_silent():
+    # a local list is scoped to one call — no residue across requests
+    src = """
+        def collect(xs):
+            out = []
+            for x in xs:
+                out.append(x)
+            return out
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu012_negative_copied_and_never_grown():
+    src = """
+        class Plan:
+            def __init__(self, faults):
+                self.faults = list(faults)
+        TABLE = []
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu012_method_local_sharing_attr_name_is_not_the_attr():
+    # a never-grown attribute must not inherit a same-named local's
+    # growth (false positive), and a grown attribute must not be
+    # silenced by a same-named local's pop (false negative)
+    src = """
+        class Server:
+            def __init__(self):
+                self.buf = []
+            def work(self, xs):
+                buf = []
+                buf.append(xs)
+                return buf
+
+        class Leaky:
+            def __init__(self):
+                self.events = []
+            def on(self, e):
+                self.events.append(e)
+            def other(self, xs):
+                events = list(xs)
+                events.pop()
+                return events
+    """
+    assert codes_of(src) == ["TPU012"]
+
+
+def test_tpu012_shadowing_function_local_is_not_the_module_queue():
+    # a function that rebinds the name operates on its local — neither
+    # its growth nor its draining belongs to the module-level binding;
+    # a `global` declaration un-shadows
+    src = """
+        pending = []
+
+        def local_noise():
+            pending = []
+            pending.append(1)
+            return pending
+
+        backlog = []
+
+        def drain_a_copy(backlog):
+            backlog.pop()
+
+        def push(x):
+            global backlog
+            backlog.append(x)
+    """
+    assert codes_of(src) == ["TPU012"]  # backlog only
+
+
+def test_tpu012_negative_swap_and_reset_drain_is_a_bound():
+    # rebinding to a fresh empty container empties the old one for gc —
+    # the swap-and-reset drain idiom is a bound, but the candidate's
+    # own initialiser must not count as one
+    src = """
+        class Collector:
+            def __init__(self):
+                self.buf = []
+
+            def add(self, x):
+                self.buf.append(x)
+
+            def flush(self):
+                out, self.buf = self.buf, []
+                return out
+
+        backlog = []
+
+        def push(x):
+            global backlog
+            backlog.append(x)
+
+        def drain():
+            global backlog
+            got = backlog
+            backlog = []
+            return got
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu012_nested_def_local_does_not_shadow_the_encloser():
+    # a NESTED def's local rebinding belongs to the nested scope only —
+    # it must not mark the enclosing function as shadowing and thereby
+    # silence the encloser's real growth of the module-level queue
+    src = """
+        PENDING = []
+
+        def worker(req):
+            PENDING.append(req)
+            def helper(xs):
+                PENDING = list(xs)
+                return PENDING
+            return helper
+    """
+    assert codes_of(src) == ["TPU012"]
 
 
 # -- TPU011: unfenced timing spans ------------------------------------------
