@@ -434,6 +434,22 @@ def _run_diagnose(argv: list[str]) -> int:
         spec = obs_spectrum.spectrum_report(
             trace, delta=problem.delta, actual_iters=int(result.iters)
         )
+        # the widened Lanczos interval — exactly what mg.cheby's setup
+        # consumes (one shared helper, obs.spectrum.eigenvalue_bounds)
+        bounds = obs_spectrum.eigenvalue_bounds(trace)
+        spec["eigenvalue_bounds"] = list(bounds) if bounds else None
+        diag_spec = None
+        if engine in ("mg-pcg", "cheb-pcg"):
+            # the yardstick: the preconditioner's kappa(M^-1 A) is only
+            # meaningful NEXT TO the diagonal baseline it displaced
+            diag_solver, diag_args, _ = build_solver(
+                problem, "xla", jdtype, history=True
+            )
+            diag_result, diag_trace = diag_solver(*diag_args)
+            diag_spec = obs_spectrum.spectrum_report(
+                diag_trace, delta=problem.delta,
+                actual_iters=int(diag_result.iters),
+            )
         prof = None
         if not args.no_profile:
             prof = obs_profile.profile_engine(
@@ -450,6 +466,8 @@ def _run_diagnose(argv: list[str]) -> int:
             "spectrum": spec,
             "profile": prof,
         }
+        if diag_spec is not None:
+            record["diag_spectrum"] = diag_spec
         if args.metrics:
             from poisson_ellipse_tpu.obs.export import MetricsExporter
 
@@ -489,6 +507,23 @@ def _run_diagnose(argv: list[str]) -> int:
                 )
             )
             print(obs_spectrum.render_report(spec))
+            if spec.get("eigenvalue_bounds"):
+                lo, hi = spec["eigenvalue_bounds"]
+                print(
+                    f"  chebyshev interval    [{lo:.6g}, {hi:.6g}]  "
+                    "(widened Lanczos bounds — what mg.cheby consumes)"
+                )
+            if diag_spec is not None and diag_spec.get("available"):
+                line = (
+                    f"  vs diag-PCG           kappa {diag_spec['kappa']:.6g}"
+                    f" in {diag_spec['iters']} iterations"
+                )
+                if spec.get("available"):
+                    line += (
+                        f" -> {diag_spec['kappa'] / spec['kappa']:.1f}x "
+                        "kappa reduction"
+                    )
+                print(line)
             if prof is not None:
                 print(obs_profile.render_profile(prof))
             if args.metrics:

@@ -80,6 +80,15 @@ def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
     """
     if engine in ("xla", "pallas"):
         return 13.0
+    if engine in ("mg-pcg", "cheb-pcg"):
+        # the classical loop's 13 plus the preconditioner's modeled
+        # extra traffic (V-cycle levels geometrically discounted /
+        # Chebyshev degree; mg.engine.modeled_extra_passes). More
+        # bytes per iteration, ~order-of-magnitude fewer iterations —
+        # the trade the bench "precond" key measures end to end.
+        from poisson_ellipse_tpu.mg.engine import modeled_extra_passes
+
+        return 13.0 + modeled_extra_passes(problem, engine, dtype)
     if engine == "fused":
         return 17.0
     if engine in ("pipelined", "pipelined-pallas"):

@@ -383,6 +383,20 @@ def run_once(
             )
             fence(args)
         shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
+    elif mode == "sharded" and engine in ("mg-pcg", "cheb-pcg"):
+        from poisson_ellipse_tpu.parallel.mg_sharded import (
+            build_mg_sharded_solver,
+        )
+        from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
+
+        with timer.phase("init"):
+            mesh = resolve_mesh(mesh_shape)
+            solver, args = build_mg_sharded_solver(
+                problem, mesh, jdtype,
+                kind=PRECOND_KIND_BY_ENGINE[engine],
+            )
+            fence(args)
+        shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
     elif mode == "sharded":
         if engine not in ("auto", "xla", "pallas", "fused", "pipelined"):
             raise ValueError(
@@ -390,8 +404,10 @@ def run_once(
                 "runs the XLA block stencil ('xla', default), the "
                 "per-shard Pallas stencil kernel ('pallas'), the "
                 "two-kernel fused per-shard iteration ('fused', f32/bf16), "
-                "or the one-psum-per-iteration pipelined recurrence "
-                "('pipelined')"
+                "the one-psum-per-iteration pipelined recurrence "
+                "('pipelined'), or the preconditioned forms ('mg-pcg' / "
+                "'cheb-pcg': V-cycle/Chebyshev per shard, halo-ppermute "
+                "only — the scalar-collective cadence stays classical)"
             )
         engine = "xla" if engine == "auto" else engine
         with timer.phase("init"):
@@ -498,6 +514,9 @@ def _warm_with_degradation(problem, jdtype, solver, args, engine: str,
             )
             del solver, args  # release the failed attempt before rebuilding
             time.sleep(_DEGRADE_BACKOFF_S)
+            # the rebuild IS the degradation ladder: one build per OOM
+            # rung, bounded by the ladder length
+            # tpulint: disable=TPU013
             solver, args, engine = build_solver(problem, nxt, jdtype)
 
 

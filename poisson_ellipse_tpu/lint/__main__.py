@@ -34,7 +34,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.lint",
         description="TPU-aware static analysis for the kernel zoo "
-        "(rules TPU001-TPU009; suppress with `# tpulint: disable=CODE`).",
+        "(rules TPU001-TPU013; suppress with `# tpulint: disable=CODE`).",
     )
     parser.add_argument(
         "paths",

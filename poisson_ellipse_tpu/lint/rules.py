@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU012.
+"""The tpulint rule registry: TPU001–TPU013.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -37,6 +37,13 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | append with no maxlen and no draining bound — |
 |        |                    | a long-lived serving process's memory leak    |
 |        |                    | (the backpressure rule: bound it or shed)     |
+| TPU013 | retraced-levels    | host-side recursion/loops that rebuild traced |
+|        |                    | callables per call — a recursive fn holding a |
+|        |                    | jit/AOT construction, or a jit-factory call   |
+|        |                    | whose argument varies with a Python loop —    |
+|        |                    | the MG-level recompile hazard: level count    |
+|        |                    | must be static per grid bucket (TPU010's      |
+|        |                    | factory-call sibling)                         |
 """
 
 from __future__ import annotations
@@ -1610,6 +1617,124 @@ def check_unbounded_queue(module: Module, config: LintConfig) -> Iterator[Findin
                     "like obs.metrics.Histogram, a drain) or shed at "
                     "admission",
                 )
+
+
+# --------------------------------------------------------------------------
+# TPU013 — traced callables rebuilt by host recursion / loop-varying factories
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "TPU013",
+    "retraced-levels",
+    "host-side Python recursion holding a jit/AOT construction, or a "
+    "jit-factory call whose argument varies with an enclosing Python "
+    "loop — a fresh trace+compile per recursion level / iteration",
+)
+def check_retraced_levels(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """The multigrid-levels recompile hazard, fenced structurally.
+
+    A V-cycle written as host recursion that jits per level — or a
+    driver looping over level/engine configurations through a
+    ``build_*``/``make_*`` factory — keys a fresh trace on every call,
+    so what reads as an O(levels) loop compiles O(levels) executables
+    per *solve*. The house contract is the opposite: level count is a
+    STATIC config per grid bucket, the recursion unrolls inside ONE
+    traced computation (``mg.vcycle``), and factories are called once
+    at build time. Two prongs (TPU010 owns the raw ``.lower().compile()``
+    -in-loop and static-argnum shapes; TPU006 the jit-construction-in-
+    loop shape — neither is repeated here):
+
+    - *recursive trace construction*: a function that calls itself AND
+      constructs ``jax.jit`` / a ``.lower().compile()`` chain in its
+      body — recursion depth is a runtime value, so each level builds
+      its own traced callable with its own empty cache.
+    - *loop-varying factory calls*: a call to a jit factory
+      (``jit-factory-patterns`` — the names whose return value is a
+      compiled callable) inside a Python loop, with an argument that
+      mentions a name the loop rebinds: one fresh solver build (trace +
+      compile) per iteration. Deliberate build-per-rung sites (warm-up
+      pools, capacity/degradation ladders) live in exempt functions
+      (``aot-warmup-fns`` / factories) or carry an annotation saying
+      why the rebuild IS the point.
+    """
+    exempt_pats = config.aot_warmup_fns + config.jit_factory_patterns
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(fnmatch.fnmatch(node.name, pat) for pat in exempt_pats):
+            # a factory's JOB is construction: bounded build-time
+            # recursion (the auto-engine chain) is not the hot path
+            continue
+        calls_self = any(
+            isinstance(c, ast.Call)
+            and isinstance(c.func, ast.Name)
+            and c.func.id == node.name
+            for c in ast.walk(node)
+        )
+        if not calls_self:
+            continue
+        for c in ast.walk(node):
+            if isinstance(c, ast.Call) and (
+                module.jit_construction(c) is not None
+                or _is_lower_compile_chain(c)
+            ):
+                yield _finding(
+                    module,
+                    c,
+                    "TPU013",
+                    f"recursive `{node.name}` builds a traced callable "
+                    "per recursion level: the level count becomes a "
+                    "runtime value and every call re-traces — make the "
+                    "level list static and unroll the recursion inside "
+                    "one traced function (the mg.vcycle pattern)",
+                )
+                break
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name):
+            leaf = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            leaf = node.func.attr
+        else:
+            continue
+        if not any(
+            fnmatch.fnmatch(leaf, pat)
+            for pat in config.jit_factory_patterns
+        ):
+            continue
+        # the patterns name PROJECT factories; jax's own make_*/build_*
+        # helpers (pltpu.make_async_copy & co.) are in-trace primitives,
+        # not trace factories
+        if (module.qualname(node.func) or "").startswith("jax."):
+            continue
+        if _enclosing_is_exempt(module, node, config):
+            continue
+        for loop in module.ancestors(node):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            varying = _loop_targets(loop)
+            hot = [
+                arg
+                for arg in list(node.args)
+                + [kw.value for kw in node.keywords]
+                if module.expr_mentions(arg, varying)
+            ]
+            if hot:
+                yield _finding(
+                    module,
+                    hot[0],
+                    "TPU013",
+                    f"jit factory `{leaf}` called with a loop-varying "
+                    "argument: every iteration traces and compiles a "
+                    "fresh solver — hoist the build, make the varying "
+                    "config static per bucket (runtime.compile_cache), "
+                    "or suppress with a note when the per-rung rebuild "
+                    "is deliberate (degradation ladders, warm-up fills)",
+                )
+                break
 
 
 @rule(

@@ -1,0 +1,50 @@
+"""Geometric multigrid + Chebyshev preconditioning (ROADMAP item 1).
+
+The iteration-count wall's killer: the reference's diagonal
+preconditioner costs O(√κ) = O(grid) PCG iterations (546 @ 400×600 →
+5889 @ 8192², BENCH_r05); the symmetric V-cycle here takes κ(M⁻¹A)
+toward O(1). Layout-generic cores (``transfer``/``coarsen``/``cheby``/
+``vcycle``) are shared by the single-chip engines (``engine`` —
+registered as ``mg-pcg``/``cheb-pcg`` in ``solver.engine``) and the
+mesh form (``parallel.mg_sharded``).
+"""
+
+from poisson_ellipse_tpu.mg.cheby import GERSHGORIN_LMAX, chebyshev_apply
+from poisson_ellipse_tpu.mg.coarsen import (
+    Level,
+    build_hierarchy,
+    coarsen_coefficients,
+    num_levels,
+)
+from poisson_ellipse_tpu.mg.engine import (
+    PrecondConfig,
+    build_precond_solver,
+    default_config,
+    lanczos_bounds,
+    make_precond,
+    modeled_extra_passes,
+)
+from poisson_ellipse_tpu.mg.transfer import (
+    prolong_bilinear,
+    restrict_full_weighting,
+)
+from poisson_ellipse_tpu.mg.vcycle import LevelOps, make_vcycle
+
+__all__ = [
+    "GERSHGORIN_LMAX",
+    "Level",
+    "LevelOps",
+    "PrecondConfig",
+    "build_hierarchy",
+    "build_precond_solver",
+    "chebyshev_apply",
+    "coarsen_coefficients",
+    "default_config",
+    "lanczos_bounds",
+    "make_precond",
+    "make_vcycle",
+    "modeled_extra_passes",
+    "num_levels",
+    "prolong_bilinear",
+    "restrict_full_weighting",
+]

@@ -1,0 +1,93 @@
+"""Chebyshev polynomial application: the smoother AND the standalone rung.
+
+One routine serves three roles — the V-cycle's pre/post smoother, its
+coarsest-level solve, and the standalone ``cheb-pcg`` preconditioner —
+because all three are the same object: a FIXED polynomial in D⁻¹A
+applied through the first-kind Chebyshev three-term recurrence over a
+target interval [lo, hi] (Saad §12.3; the smoother variant is Adams et
+al.'s parallel-multigrid Chebyshev smoothing). Fixed degree is the
+load-bearing property: the applier is a linear operator
+``B = p(D⁻¹A) D⁻¹`` with B symmetric (D⁻¹ᐟ² p(D⁻¹ᐟ²AD⁻¹ᐟ²) D⁻¹ᐟ² — a
+polynomial of a symmetric matrix), so standard PCG stays valid; an
+adaptive/restarted variant would silently demand flexible CG.
+
+Positivity (the SPD half) holds when ``hi`` covers λmax(D⁻¹A): the
+residual polynomial q has |q| < 1 on (0, hi], so p(λ) = (1 − q(λ))/λ > 0
+there. Below ``lo`` the polynomial merely damps less — an overestimated
+λmin costs iterations, never definiteness — which is why the Lanczos
+λmin estimate can ride a generous slack while λmax carries a hard
+Gershgorin cap (``GERSHGORIN_LMAX``: the Jacobi-scaled 5-point M-matrix
+has row radius ≤ 1 around center 1).
+
+The recurrence is unrolled at trace time (degree is a static config per
+grid bucket — tpulint TPU013's contract), all coefficients Python
+floats baked into the compile: zero host syncs, zero collectives, one
+stencil + one pointwise D⁻¹ per step.
+"""
+
+from __future__ import annotations
+
+# provable upper bound on λmax(D⁻¹A) for the 5-point operator with
+# positive face coefficients: Gershgorin row center 1, radius =
+# (Σ off-diag)/d ≤ 1. The hard cap every Lanczos-derived hi is clipped to.
+GERSHGORIN_LMAX = 2.0
+
+# target interval fallback when no Lanczos trace is usable: the full
+# Gershgorin interval with a generic ill-conditioning guess on the low
+# side (harmless: below-lo eigenmodes stay positive, see module docstring)
+FALLBACK_LO_FRAC = 1e-4
+
+
+def chebyshev_apply(apply_op, dinv, r, lo: float, hi: float, degree: int,
+                    x=None):
+    """x ≈ A⁻¹ r by ``degree`` Chebyshev steps on D⁻¹A over [lo, hi].
+
+    ``apply_op``/``dinv`` are the level's A· and D⁻¹· closures (global
+    or block layout — the caller owns masking and halo exchange).
+    ``x=None`` starts from zero (one A-application saved — the pre-
+    smoother and preconditioner case); otherwise smooths the given
+    iterate (the post-smoother case). A-applications: ``degree − 1``
+    from zero, ``degree`` otherwise.
+    """
+    if degree < 1:
+        raise ValueError("chebyshev degree must be >= 1")
+    if not (0.0 < lo < hi):
+        raise ValueError(f"need 0 < lo < hi, got [{lo}, {hi}]")
+    theta = 0.5 * (hi + lo)
+    delta = 0.5 * (hi - lo)
+    sigma = theta / delta
+    rho = 1.0 / sigma
+    res = r if x is None else r - apply_op(x)
+    d = dinv(res) * (1.0 / theta)
+    x = d if x is None else x + d
+    for _ in range(degree - 1):
+        res = res - apply_op(d)
+        rho_new = 1.0 / (2.0 * sigma - rho)
+        d = (rho_new * rho) * d + (2.0 * rho_new / delta) * dinv(res)
+        rho = rho_new
+        x = x + d
+    return x
+
+
+def clip_interval(bounds: tuple[float, float] | None) -> tuple[float, float]:
+    """A safe Chebyshev target interval from Lanczos bounds (or None).
+
+    The high side is clipped to the Gershgorin cap (a Lanczos hi above 2
+    is estimator noise — the true spectrum cannot reach it); a missing
+    or degenerate estimate falls back to the full Gershgorin interval.
+    """
+    if bounds is None:
+        return (FALLBACK_LO_FRAC * GERSHGORIN_LMAX, GERSHGORIN_LMAX)
+    lo, hi = bounds
+    hi = min(hi, GERSHGORIN_LMAX)
+    if not (0.0 < lo < hi):
+        return (FALLBACK_LO_FRAC * GERSHGORIN_LMAX, GERSHGORIN_LMAX)
+    return lo, hi
+
+
+def smoother_interval(hi: float, frac: float = 4.0) -> tuple[float, float]:
+    """The smoothing band [hi/frac, hi]: damp the upper spectrum, leave
+    the smooth modes to the coarse grid (frac = 4 is the standard 2D
+    choice; modes below hi/frac are contracted by the coarse-grid
+    correction instead)."""
+    return hi / frac, hi

@@ -1,0 +1,169 @@
+"""Fictitious-domain-aware coefficient coarsening + the level hierarchy.
+
+The operator's difficulty is the ε-jump: face coefficients are 1 inside
+the ellipse and 1/ε = 1/max(h1,h2)² outside (``ops/assembly.py``), four
+to eight orders of magnitude at the published grids. A coarse operator
+that arithmetic-averages across that jump overestimates the flux through
+the interface by ~1/ε and the V-cycle stalls on interface modes, so the
+coarsening here is the flux-preserving face average of the cell-centered
+multigrid literature (Alcouffe et al.'s diffusion-coefficient MG; the
+same choice Tatebe's MGCG setup makes for discontinuous coefficients):
+
+- **harmonic** across the two fine faces stacked along the flux
+  direction (serial resistors: the jump survives, the 1/ε side does not
+  swamp the 1 side), then
+- **arithmetic** (geometric-overlap weighted ¼, ½, ¼) across the three
+  fine face strips the coarse face spans tangentially (parallel
+  conductors).
+
+A coarse face of a level-(l+1) grid at coarse node (I, J) covers fine
+faces {2I−1, 2I} × {2J−1, 2J, 2J+1} of level l; the resulting
+coefficients are strictly positive wherever the fine ones are, so every
+coarse operator is again a 5-point SPD M-matrix with λ(D⁻¹A) ⊂ (0, 2]
+by the same Gershgorin row argument as the fine level — SPD is pinned
+numerically in ``tests/test_mg.py``, not assumed.
+
+Coarsening runs on the HOST in float64 (the same rounded-once fidelity
+stance as ``ops.assembly.assemble_numpy``: f32 coefficient noise is
+amplified 1/ε by the blend law) and each level is cast to the solve
+dtype exactly once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.stencil import diag_d
+
+# levels stop when the next grid would fall below this many cells per
+# side (the coarsest level is solved by a heavier Chebyshev sweep, so a
+# handful of cells is enough) or exceed this depth (a static budget —
+# level count must be a compile-time constant per grid bucket, tpulint
+# TPU013's contract)
+MIN_COARSE_CELLS = 4
+MAX_LEVELS = 8
+
+
+def _harm(u, v, xp):
+    """Element-wise harmonic mean with the zero guard (zeros stay zero:
+    an absent face — boundary ring, shard padding — must not conjure
+    conductance)."""
+    s = u + v
+    safe = xp.where(s > 0, s, 1.0)
+    return xp.where(s > 0, 2.0 * u * v / safe, 0.0)
+
+
+def num_levels(M: int, N: int, max_levels: int = MAX_LEVELS,
+               min_cells: int = MIN_COARSE_CELLS) -> int:
+    """Static level count for an M×N grid (1 = no coarsening).
+
+    Halving stops at odd cell counts (node-nested coarsening needs even
+    M, N), below ``min_cells``, or at the ``max_levels`` budget.
+    """
+    levels = 1
+    while (
+        levels < max_levels
+        and M % 2 == 0 and N % 2 == 0
+        and M // 2 >= min_cells and N // 2 >= min_cells
+    ):
+        M //= 2
+        N //= 2
+        levels += 1
+    return levels
+
+
+def coarsen_coefficients(a, b, xp=np):
+    """One level of face-coefficient coarsening: (M+1, N+1) → (M/2+1, N/2+1).
+
+    ``a`` lives on vertical faces (flux along x): harmonic across rows
+    {2I−1, 2I}, overlap-weighted arithmetic across columns
+    {2J−1, 2J, 2J+1}; ``b`` symmetrically. Entries outside the valid
+    face range stay zero (the assembly convention).
+    """
+    M, N = a.shape[0] - 1, a.shape[1] - 1
+    if M % 2 or N % 2:
+        raise ValueError(f"coarsening needs even cell counts, got {M}x{N}")
+    mc, nc = M // 2, N // 2
+
+    ha = _harm(a[1:M:2, :], a[2 : M + 1 : 2, :], xp)  # (mc, N+1)
+    hap = xp.pad(ha, ((0, 0), (0, 1)))
+    ac = (
+        0.25 * hap[:, 1:N:2]
+        + 0.5 * hap[:, 2 : N + 1 : 2]
+        + 0.25 * hap[:, 3 : N + 2 : 2]
+    )
+    ac = xp.pad(ac, ((1, 0), (1, 0)))
+
+    hb = _harm(b[:, 1:N:2], b[:, 2 : N + 1 : 2], xp)  # (M+1, nc)
+    hbp = xp.pad(hb, ((0, 1), (0, 0)))
+    bc = (
+        0.25 * hbp[1:M:2, :]
+        + 0.5 * hbp[2 : M + 1 : 2, :]
+        + 0.25 * hbp[3 : M + 2 : 2, :]
+    )
+    bc = xp.pad(bc, ((1, 0), (1, 0)))
+    assert ac.shape == (mc + 1, nc + 1) and bc.shape == (mc + 1, nc + 1)
+    return ac, bc
+
+
+@dataclasses.dataclass(frozen=True)
+class Level:
+    """One grid level's operator data (device arrays, solve dtype)."""
+
+    M: int
+    N: int
+    h1: float
+    h2: float
+    a: jnp.ndarray
+    b: jnp.ndarray
+    d: jnp.ndarray  # diag of A, zero on the ring (the smoother's D)
+
+    @property
+    def node_shape(self) -> tuple[int, int]:
+        return (self.M + 1, self.N + 1)
+
+
+def coefficient_hierarchy(problem: Problem) -> list[dict]:
+    """Host-f64 (a, b) per level, finest first — the shared source both
+    the single-chip and the mg-sharded builders cast/lay out from."""
+    a, b, _ = assembly.assemble_numpy(problem)
+    levels = num_levels(problem.M, problem.N)
+    out = [{
+        "M": problem.M, "N": problem.N,
+        "h1": problem.h1, "h2": problem.h2, "a": a, "b": b,
+    }]
+    for _ in range(levels - 1):
+        prev = out[-1]
+        ac, bc = coarsen_coefficients(prev["a"], prev["b"], np)
+        out.append({
+            "M": prev["M"] // 2, "N": prev["N"] // 2,
+            "h1": prev["h1"] * 2.0, "h2": prev["h2"] * 2.0,
+            "a": ac, "b": bc,
+        })
+    return out
+
+
+def build_hierarchy(problem: Problem, dtype=jnp.float32) -> list[Level]:
+    """The device-resident level list (finest first) for one chip.
+
+    Coefficients are coarsened on the host in f64 and cast once; the
+    per-level diagonal is computed in the solve dtype, matching the fine
+    engine's ``diag_d``-of-cast-operands arithmetic exactly at level 0.
+    """
+    np_dtype = assembly.numpy_dtype(dtype)
+    out = []
+    for lv in coefficient_hierarchy(problem):
+        a = jnp.asarray(lv["a"].astype(np_dtype))
+        b = jnp.asarray(lv["b"].astype(np_dtype))
+        h1 = jnp.asarray(lv["h1"], dtype)
+        h2 = jnp.asarray(lv["h2"], dtype)
+        out.append(Level(
+            M=lv["M"], N=lv["N"], h1=lv["h1"], h2=lv["h2"],
+            a=a, b=b, d=diag_d(a, b, h1, h2),
+        ))
+    return out

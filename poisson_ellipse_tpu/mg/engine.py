@@ -1,0 +1,247 @@
+"""The mg-pcg / cheb-pcg single-chip engines: build, bounds, cost model.
+
+Both engines are the CLASSICAL fused PCG loop (``solver.pcg`` — same
+carry, same stopping rule, same history contract, zero host syncs per
+iteration) with the ``precond`` hook swapped from the reference's
+diagonal to:
+
+- **cheb-pcg** — the degree-k Chebyshev polynomial in D⁻¹A over the
+  Lanczos-estimated spectral interval (``mg.cheby``): the cheap first
+  rung. k stencil passes per iteration buy a ~k× iteration cut, so it
+  mostly converts reduce→broadcast latency into streaming work — the
+  win grows with grid size and mesh size.
+- **mg-pcg** — the symmetric V-cycle over the coarsened-coefficient
+  hierarchy (``mg.coarsen`` + ``mg.vcycle``) with Chebyshev smoothers:
+  the iteration-count killer. κ(M⁻¹A) stops growing with the grid, so
+  the 546 → 5889 iteration wall (BENCH_r05) flattens to O(10¹).
+
+Eigenvalue bounds come from ONE source: a short diagonal-PCG probe
+whose recorded α/β feed ``obs.spectrum.eigenvalue_bounds`` (the same
+helper ``harness diagnose`` reports) — the Lanczos estimate the ROADMAP
+telemetry already validated, clipped to the Gershgorin cap. The probe
+is a build-time cost (one short jitted solve), cached per (problem,
+dtype) alongside the hierarchy.
+
+Setup (hierarchy + probe) happens at ``build_*`` time — the solver the
+builders return is jitted once and dispatched many times, the
+engine-zoo contract. Level count, smoother degree and Chebyshev degree
+are STATIC per grid bucket (tpulint TPU013).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.mg import cheby, coarsen, vcycle
+from poisson_ellipse_tpu.mg.transfer import (
+    prolong_bilinear,
+    restrict_full_weighting,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv
+from poisson_ellipse_tpu.solver.pcg import pcg as run_pcg
+
+# iterations of the diagonal-PCG bounds probe: enough Lanczos steps for
+# a tight λmax (converges in ~10) and a usable λmin order of magnitude
+PROBE_ITERS = 48
+
+# standalone Chebyshev preconditioner degree: each PCG iteration pays
+# degree−1 extra stencil passes for a ~degree× iteration cut — 12 keeps
+# the wall-clock trade profitable while staying far from f32 recurrence
+# round-off
+DEFAULT_CHEB_DEGREE = 12
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecondConfig:
+    """Static preconditioner configuration for one grid bucket."""
+
+    kind: str  # "mg" | "cheb"
+    levels: int
+    nu: int = vcycle.DEFAULT_NU
+    coarse_degree: int = vcycle.DEFAULT_COARSE_DEGREE
+    cheb_degree: int = DEFAULT_CHEB_DEGREE
+    lo: float = 0.0  # Lanczos/Gershgorin interval actually used
+    hi: float = cheby.GERSHGORIN_LMAX
+
+
+def default_config(problem: Problem, kind: str) -> PrecondConfig:
+    """The per-grid-bucket static config (level count from the grid)."""
+    if kind not in ("mg", "cheb"):
+        raise ValueError(f"unknown preconditioner kind: {kind!r}")
+    levels = coarsen.num_levels(problem.M, problem.N) if kind == "mg" else 1
+    return PrecondConfig(kind=kind, levels=levels)
+
+
+def lanczos_bounds(problem: Problem, a, b, rhs,
+                   probe_iters: int = PROBE_ITERS):
+    """(λ_lo, λ_hi) of D⁻¹A from a short diagonal-PCG probe, or None.
+
+    One jitted ``probe_iters``-capped history solve; the recorded α/β
+    feed ``obs.spectrum.eigenvalue_bounds`` — the single shared Lanczos
+    path, not a reimplementation. Build-time only, never on the hot path.
+    """
+    from poisson_ellipse_tpu.obs import spectrum as obs_spectrum
+
+    probe = dataclasses.replace(
+        problem, max_iter=min(probe_iters, problem.max_iterations)
+    )
+    # single-shot by design: the probe runs once per build, and the
+    # operands are the caller's — not this jit's to donate
+    _res, trace = jax.jit(  # tpulint: disable=TPU004,TPU006
+        lambda a, b, rhs: run_pcg(probe, a, b, rhs, history=True)
+    )(a, b, rhs)
+    return obs_spectrum.eigenvalue_bounds(trace)
+
+
+def resolve_config(problem: Problem, a, b, rhs, kind: str) -> PrecondConfig:
+    """``default_config`` with the probe's spectral interval filled in."""
+    cfg = default_config(problem, kind)
+    lo, hi = cheby.clip_interval(lanczos_bounds(problem, a, b, rhs))
+    return dataclasses.replace(cfg, lo=lo, hi=hi)
+
+
+def _level_ops(levels: list[coarsen.Level], cfg: PrecondConfig,
+               fine_a=None, fine_b=None) -> list[vcycle.LevelOps]:
+    """Global-layout LevelOps per level. The finest level's stencil runs
+    on the CALLER's operands (``fine_a``/``fine_b`` — the same arrays
+    the PCG loop streams, so no duplicate resident copy of the big
+    grid); coarse levels close over the hierarchy's baked arrays.
+
+    The smoothing band is anchored at the probe's λ_hi on every level —
+    coarsened coefficients keep the Gershgorin cap, and the Jacobi
+    scaling keeps the upper edge essentially level-independent. The
+    low edge at level l scales the fine λ_lo by 4ˡ (κ ∝ h⁻²), capped
+    inside the band — only the coarsest solve interval consumes it, and
+    an overestimate costs sweeps, never definiteness (``mg.cheby``).
+    """
+    smooth_lo, smooth_hi = cheby.smoother_interval(cfg.hi)
+    out = []
+    for l, lv in enumerate(levels):
+        a = fine_a if (l == 0 and fine_a is not None) else lv.a
+        b = fine_b if (l == 0 and fine_b is not None) else lv.b
+        h1 = jnp.asarray(lv.h1, lv.d.dtype)
+        h2 = jnp.asarray(lv.h2, lv.d.dtype)
+        d = lv.d
+
+        def make_apply(a=a, b=b, h1=h1, h2=h2):
+            return lambda x: apply_a(x, a, b, h1, h2)
+
+        def make_dinv(d=d):
+            return lambda x: apply_dinv(x, d)
+
+        solve_lo = min(cfg.lo * (4.0 ** l), smooth_hi / 4.0)
+        last = l == len(levels) - 1
+        fine_shape = lv.node_shape
+
+        out.append(vcycle.LevelOps(
+            apply_a=make_apply(),
+            dinv=make_dinv(),
+            smooth_lo=smooth_lo,
+            smooth_hi=cfg.hi,
+            solve_lo=solve_lo,
+            restrict=None if last else restrict_full_weighting,
+            prolong=None if last else (
+                lambda uc, shape=fine_shape: prolong_bilinear(uc, shape)
+            ),
+        ))
+    return out
+
+
+def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
+                 config: PrecondConfig | None = None, operands=None):
+    """(precond_factory, config): the engine-facing build.
+
+    ``precond_factory(a, b) -> (r -> M⁻¹ r)`` is called INSIDE the
+    solver trace with the solve's own fine operands; the hierarchy and
+    spectral interval are resolved here, once, on the host. ``operands``
+    lets a caller that already assembled (a, b, rhs) skip the duplicate
+    assembly (the guard's fallback path hands its own operands over).
+    A supplied ``config`` carrying a degenerate interval (the dataclass
+    default lo=0.0 — only ``resolve_config`` fills a probed one) is
+    normalised through the Gershgorin fallback instead of crashing the
+    Chebyshev setup at trace time.
+    """
+    a, b, rhs = (
+        operands if operands is not None
+        else assembly.assemble(problem, dtype)
+    )
+    cfg = config if config is not None else resolve_config(
+        problem, a, b, rhs, kind
+    )
+    lo, hi = cheby.clip_interval((cfg.lo, cfg.hi))
+    if (lo, hi) != (cfg.lo, cfg.hi):
+        cfg = dataclasses.replace(cfg, lo=lo, hi=hi)
+    if cfg.kind == "cheb":
+        hier = None
+    else:
+        hier = coarsen.build_hierarchy(problem, dtype)[: cfg.levels]
+
+    def factory(fine_a, fine_b):
+        if cfg.kind == "cheb":
+            from poisson_ellipse_tpu.ops.stencil import diag_d
+
+            h1 = jnp.asarray(problem.h1, dtype)
+            h2 = jnp.asarray(problem.h2, dtype)
+            d = diag_d(fine_a, fine_b, h1, h2)
+            return lambda r: cheby.chebyshev_apply(
+                lambda x: apply_a(x, fine_a, fine_b, h1, h2),
+                lambda x: apply_dinv(x, d),
+                r, cfg.lo, cfg.hi, cfg.cheb_degree,
+            )
+        ops = _level_ops(hier, cfg, fine_a=fine_a, fine_b=fine_b)
+        return vcycle.make_vcycle(ops, nu=cfg.nu,
+                                  coarse_degree=cfg.coarse_degree)
+
+    return factory, cfg
+
+
+def build_precond_solver(problem: Problem, engine: str, dtype=jnp.float32,
+                         history: bool = False):
+    """(jitted solver, args, resolved engine) — the ``solver.engine``
+    branch for ``mg-pcg`` / ``cheb-pcg``. Same contract as every other
+    engine: args = the assembled (a, b, rhs), one fused while_loop, the
+    ``PCGResult`` (+ optional ``ConvergenceTrace``) out."""
+    from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
+
+    a, b, rhs = assembly.assemble(problem, dtype)
+    factory, _cfg = make_precond(
+        problem, dtype, PRECOND_KIND_BY_ENGINE[engine],
+        operands=(a, b, rhs),
+    )
+
+    # no donation: the build-once-call-many contract re-feeds these
+    # operands on every dispatch (the timing protocols re-dispatch)
+    solver = jax.jit(  # tpulint: disable=TPU004
+        lambda a, b, rhs: run_pcg(
+            problem, a, b, rhs, history=history, precond=factory(a, b)
+        )
+    )
+    return solver, (a, b, rhs), engine
+
+
+def modeled_extra_passes(problem: Problem, engine: str,
+                         dtype=jnp.float32) -> float:
+    """HBM array-passes the preconditioner adds per PCG iteration, for
+    ``harness.roofline``'s traffic model. Each Chebyshev step streams
+    one stencil application (4 passes: read x, a, b; write) plus the
+    pointwise D⁻¹-scaled update (~3 passes); level-l arrays are 4⁻ˡ of
+    the fine array. Transfers add ~2 fine-equivalent passes per level
+    pair. A model, not a measurement — same stance as the rest of the
+    roofline module."""
+    from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
+
+    per_apply = 7.0
+    cfg = default_config(problem, PRECOND_KIND_BY_ENGINE[engine])
+    if cfg.kind == "cheb":
+        return per_apply * max(cfg.cheb_degree - 1, 0) + 2.0
+    applies = vcycle.stencil_applies_per_cycle(
+        cfg.levels, cfg.nu, cfg.coarse_degree
+    )
+    passes = sum(n * per_apply * (0.25 ** l) for l, n in enumerate(applies))
+    transfers = sum(2.0 * (0.25 ** l) for l in range(cfg.levels - 1))
+    return passes + transfers

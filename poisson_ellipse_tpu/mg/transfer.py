@@ -1,0 +1,140 @@
+"""Grid-transfer operators: bilinear prolongation, full-weighting restriction.
+
+Both layouts the framework's stencils support get a transfer pair:
+
+- **global**: full (M+1, N+1) node grids with the Dirichlet ring at
+  rows/cols 0, M, N (the single-chip engines' layout). Coarse and fine
+  grids nest node-on-node: coarse node (I, J) sits at fine node
+  (2I, 2J), which requires M and N even — ``mg.coarsen`` picks the
+  level count so this holds at every level.
+- **block**: one device's halo-extended block (the ``shard_map`` layout
+  of ``parallel``): restriction consumes a halo-extended fine block,
+  prolongation a halo-extended coarse block, so one
+  ``parallel.halo.halo_extend`` round per transfer is the whole
+  communication story (4 ``lax.ppermute``; no psum — the V-cycle adds
+  ZERO scalar collectives to the PCG iteration).
+
+The pair is a (scaled) adjoint: ``R = Pᵀ/4`` exactly, including the
+boundary handling — both operators mask the Dirichlet ring of their
+input and output, which makes the matrix identity hold on the full node
+space, not just the interior (pinned as dense matrices in
+``tests/test_mg.py``). The 1/4 is the standard 2D full-weighting scale
+(Pᵀ's rows sum to 4); symmetry of the V-cycle preconditioner needs only
+``R ∝ Pᵀ``, which this fixes by construction rather than by audit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def zero_ring(u):
+    """Zero the outermost ring (the Dirichlet boundary of a node grid)."""
+    return jnp.pad(u[1:-1, 1:-1], 1)
+
+
+def _interleave(ce, cr, de, dr):
+    """(m, n) corner values → the (2m, 2n) bilinear interleave.
+
+    ``ce`` holds coarse values, ``cr``/``de``/``dr`` their right/down/
+    diagonal neighbours; fine node (2i, 2j) gets ce, (2i, 2j+1) the
+    x-average, (2i+1, 2j) the y-average, (2i+1, 2j+1) the 4-average.
+    Built by stack-and-reshape rather than strided scatter: one fused
+    elementwise pass, and no mixing of varying values into an unvarying
+    zeros buffer under ``shard_map``'s vma checking.
+    """
+    m, n = ce.shape
+    top = jnp.stack([ce, 0.5 * (ce + cr)], axis=-1).reshape(m, 2 * n)
+    bot = jnp.stack(
+        [0.5 * (ce + de), 0.25 * (ce + cr + de + dr)], axis=-1
+    ).reshape(m, 2 * n)
+    return jnp.stack([top, bot], axis=1).reshape(2 * m, 2 * n)
+
+
+def prolong_bilinear(uc, fine_shape: tuple[int, int]):
+    """Bilinear interpolation coarse (Mc+1, Nc+1) → fine (2Mc+1, 2Nc+1).
+
+    Fine node (2I, 2J) receives the coarse value; odd fine nodes the
+    2-point (edges) / 4-point (cell centers) averages. The coarse ring
+    is masked first, so the operator's matrix has zero columns for ring
+    coarse nodes and zero rows for ring fine nodes — the exact partner
+    of :func:`restrict_full_weighting`'s masking.
+    """
+    uc = zero_ring(uc)
+    u = _interleave(uc[:-1, :-1], uc[:-1, 1:], uc[1:, :-1], uc[1:, 1:])
+    # the last fine row/col (2Mc, 2Nc) is the Dirichlet ring: the
+    # masked coarse ring value, i.e. exactly zero
+    return jnp.pad(u, ((0, 1), (0, 1)))
+
+
+def restrict_full_weighting(uf):
+    """Full-weighting restriction fine (M+1, N+1) → coarse (M/2+1, N/2+1).
+
+    The 9-point stencil 1/16·[1 2 1; 2 4 2; 1 2 1] — exactly Pᵀ/4 of
+    :func:`prolong_bilinear` (both rings masked; adjoint pinned as
+    matrices in ``tests/test_mg.py``).
+    """
+    uf = zero_ring(uf)
+    g1, g2 = uf.shape
+    mc, nc = (g1 - 1) // 2, (g2 - 1) // 2
+    up = jnp.pad(uf, 1)
+
+    def tap(di: int, dj: int):
+        # tap(di, dj)[I, J] = uf[2I + di, 2J + dj], zero off the grid
+        return up[1 + di : 2 + di + 2 * mc : 2, 1 + dj : 2 + dj + 2 * nc : 2]
+
+    out = 0.25 * (
+        tap(0, 0)
+        + 0.5 * (tap(-1, 0) + tap(1, 0) + tap(0, -1) + tap(0, 1))
+        + 0.25 * (tap(-1, -1) + tap(-1, 1) + tap(1, -1) + tap(1, 1))
+    )
+    return zero_ring(out)
+
+
+# -- block (shard_map) layout ------------------------------------------------
+
+
+def restrict_block(uf_ext):
+    """Full-weighting over one halo-extended fine block.
+
+    (bm+2, bn+2) halo-extended fine block → (bm/2, bn/2) coarse block.
+    Coarse local (ic, jc) sits at fine local (2ic, 2jc) — blocks stay
+    aligned because the mg-sharded padding keeps every level's block
+    even (``parallel.mg_sharded``). The 9-point gather reaches across
+    the shard edge through the halo, so the one ``halo_extend`` the
+    caller already paid is the entire communication. The caller masks
+    the result with the coarse level's global-interior mask (the block
+    twin of the global form's ring-zeroing).
+    """
+    bm, bn = uf_ext.shape[0] - 2, uf_ext.shape[1] - 2
+    bmc, bnc = bm // 2, bn // 2
+
+    def tap(di: int, dj: int):
+        # tap(di, dj)[ic, jc] = fine_local[2ic + di, 2jc + dj]
+        return uf_ext[
+            1 + di : 2 + di + 2 * (bmc - 1) + 1 : 2,
+            1 + dj : 2 + dj + 2 * (bnc - 1) + 1 : 2,
+        ]
+
+    return 0.25 * (
+        tap(0, 0)
+        + 0.5 * (tap(-1, 0) + tap(1, 0) + tap(0, -1) + tap(0, 1))
+        + 0.25 * (tap(-1, -1) + tap(-1, 1) + tap(1, -1) + tap(1, 1))
+    )
+
+
+def prolong_block(uc_ext, fine_block_shape: tuple[int, int]):
+    """Bilinear interpolation over one halo-extended coarse block.
+
+    (bmc+2, bnc+2) halo-extended coarse block → (bm, bn) = (2bmc, 2bnc)
+    fine block. Odd fine rows/cols straddle the high block edge, which
+    the coarse halo supplies — again one ``halo_extend`` is the whole
+    exchange. The caller masks with the fine level's interior mask.
+    """
+    bm, bn = fine_block_shape
+    u = _interleave(
+        uc_ext[1:-1, 1:-1], uc_ext[1:-1, 2:],
+        uc_ext[2:, 1:-1], uc_ext[2:, 2:],
+    )
+    assert u.shape == (bm, bn), (u.shape, fine_block_shape)
+    return u

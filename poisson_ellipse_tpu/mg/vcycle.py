@@ -1,0 +1,111 @@
+"""The symmetric V-cycle, generic over layout — written once, run anywhere.
+
+``make_vcycle`` consumes a list of per-level closure bundles
+(:class:`LevelOps`) and returns the preconditioner applier
+``z = M⁻¹ r``. The closures carry every layout decision — global node
+grids for the single-chip engines, halo-exchanged shard blocks for the
+mesh form (``parallel.mg_sharded``) — so the cycle structure, the
+symmetry argument and the collective discipline live in exactly one
+place instead of once per engine family.
+
+Structure (Tatebe's multigrid-preconditioned CG):
+
+    pre-smooth from zero:   x  = B r            (ν Chebyshev steps)
+    coarse-grid correction: x += P Mc⁻¹ R (r − A x)
+    post-smooth:            x  = x + B (r − A x) (ν steps, same B)
+
+Symmetry is by construction, not luck: B = p(D⁻¹A)D⁻¹ is a symmetric
+matrix (``mg.cheby``), R = Pᵀ/4 (``mg.transfer``), the coarse operator
+is symmetric (5-point, coarsened coefficients), and Mc⁻¹ is recursively
+the same shape with a pure-Chebyshev coarsest solve — so the A-adjoint
+of the pre-smoothing error propagator I − BA is itself, and
+M⁻¹ = M⁻ᵀ follows level by level. Fixed ν and degree keep M linear:
+standard PCG remains valid (no flexible-CG escape hatch), asserted as
+⟨M⁻¹x, y⟩ = ⟨x, M⁻¹y⟩ on random vectors in ``tests/test_mg.py``.
+
+The recursion below is PYTHON recursion over a STATIC level list — it
+unrolls into the one traced computation at compile time (the whole
+V-cycle runs inside the PCG ``lax.while_loop`` body with zero host
+syncs). Re-tracing per call — a level count that varies at run time —
+is the recompile hazard tpulint TPU013 exists to flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from poisson_ellipse_tpu.mg.cheby import chebyshev_apply
+
+# pre/post smoothing degree and the coarsest-level Chebyshev "solve"
+# degree: V(2,2) with a degree-~24 coarsest sweep is the classical
+# robust default for coefficient-jump problems; both are static config
+# per grid bucket (never data-dependent)
+DEFAULT_NU = 2
+DEFAULT_COARSE_DEGREE = 24
+
+
+@dataclasses.dataclass(frozen=True)
+class LevelOps:
+    """One level's closures. ``restrict`` maps this level's residual to
+    the NEXT level; ``prolong`` lifts the next level's correction back
+    (both None on the coarsest). ``smooth_lo/hi`` is the Chebyshev
+    smoothing band; ``solve_lo`` the coarsest level's full-interval low
+    edge (used only when this level is last)."""
+
+    apply_a: Callable
+    dinv: Callable
+    smooth_lo: float
+    smooth_hi: float
+    solve_lo: float
+    restrict: Callable | None = None
+    prolong: Callable | None = None
+
+
+def make_vcycle(levels: list[LevelOps], nu: int = DEFAULT_NU,
+                coarse_degree: int = DEFAULT_COARSE_DEGREE) -> Callable:
+    """The ``z = M⁻¹ r`` applier for a static level list (finest first).
+
+    A single level degenerates to one Chebyshev application (the
+    standalone polynomial preconditioner with the smoothing band
+    replaced by the full interval) — the mg engine on an uncoarsenable
+    grid still returns a valid SPD preconditioner.
+    """
+    if not levels:
+        raise ValueError("need at least one level")
+
+    def cycle(l: int, r):
+        ops = levels[l]
+        if l == len(levels) - 1:
+            # coarsest: a heavier Chebyshev sweep over the full interval
+            # approximates the coarse solve — still a fixed polynomial,
+            # still symmetric, no factorization, no host work
+            return chebyshev_apply(
+                ops.apply_a, ops.dinv, r, ops.solve_lo, ops.smooth_hi,
+                coarse_degree,
+            )
+        x = chebyshev_apply(
+            ops.apply_a, ops.dinv, r, ops.smooth_lo, ops.smooth_hi, nu
+        )
+        coarse_r = ops.restrict(r - ops.apply_a(x))
+        x = x + ops.prolong(cycle(l + 1, coarse_r))
+        return chebyshev_apply(
+            ops.apply_a, ops.dinv, r, ops.smooth_lo, ops.smooth_hi, nu, x=x
+        )
+
+    return lambda r: cycle(0, r)
+
+
+def stencil_applies_per_cycle(n_levels: int, nu: int = DEFAULT_NU,
+                              coarse_degree: int = DEFAULT_COARSE_DEGREE,
+                              ) -> list[int]:
+    """A-applications per level for one V-cycle application, finest
+    first — the static cost model ``harness.roofline`` and the halo
+    accounting (``parallel.mg_sharded.halos_per_precond``) share.
+
+    Per non-coarsest level: pre-smooth ν−1 (zero start), residual 1,
+    post-smooth ν (nonzero start); coarsest: degree−1.
+    """
+    if n_levels == 1:
+        return [coarse_degree - 1]
+    return [2 * nu] * (n_levels - 1) + [coarse_degree - 1]

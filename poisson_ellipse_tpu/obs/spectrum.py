@@ -47,11 +47,45 @@ import numpy as np
 __all__ = [
     "cg_coefficients",
     "detect_plateaus",
+    "eigenvalue_bounds",
     "lanczos_tridiagonal",
     "predicted_iterations",
     "ritz_values",
     "spectrum_report",
 ]
+
+# Ritz values are INTERIOR estimates of the spectrum (λ_min is
+# overestimated, λ_max underestimated, both converging outward as the
+# Lanczos process runs), so consumers that need a covering interval —
+# the Chebyshev setup in ``mg.cheby`` — widen by these defaults. λ_min
+# of an ill-conditioned operator converges slowest, hence the larger
+# slack on that side; λ_max of the Jacobi-preconditioned 5-point
+# operator is provably ≤ 2 (Gershgorin: row center 1, radius ≤ 1), so
+# the high side needs only a trim.
+LMIN_SLACK = 0.5
+LMAX_SLACK = 1.05
+
+
+def eigenvalue_bounds(
+    trace, lo_slack: float = LMIN_SLACK, hi_slack: float = LMAX_SLACK,
+) -> tuple[float, float] | None:
+    """(λ_lo, λ_hi) covering the spectrum of M⁻¹A, from a CG trace.
+
+    The single source the Chebyshev/multigrid setup consumes
+    (``mg.cheby``) and ``harness diagnose`` reports — one Lanczos
+    reconstruction, not two. The extremal Ritz values are widened by
+    the slack factors (see above) into an interval the true spectrum
+    sits inside for any usably long trace. Returns None when the trace
+    yields no usable positive spectrum (the caller falls back to the
+    Gershgorin interval).
+    """
+    vals = ritz_values(trace)
+    if vals.size == 0:
+        return None
+    lmin, lmax = float(vals[0]), float(vals[-1])
+    if not (math.isfinite(lmin) and math.isfinite(lmax)) or lmin <= 0:
+        return None
+    return lmin * lo_slack, lmax * hi_slack
 
 
 def _valid_series(trace) -> dict:

@@ -47,7 +47,9 @@ COLLECTIVE_PRIMS = (
     "all_to_all",
 )
 
-SHARDED_ENGINES = ("xla", "pallas", "fused", "pipelined")
+SHARDED_ENGINES = (
+    "xla", "pallas", "fused", "pipelined", "mg-pcg", "cheb-pcg",
+)
 
 
 # -- jaxpr walking -----------------------------------------------------------
@@ -170,6 +172,18 @@ def _build(problem: Problem, engine: str, dtype, mode: str, mesh_shape):
                 f"(sharded engines: {', '.join(SHARDED_ENGINES)})"
             )
         mesh = resolve_mesh(mesh_shape)
+        if engine in ("mg-pcg", "cheb-pcg"):
+            from poisson_ellipse_tpu.parallel.mg_sharded import (
+                build_mg_sharded_solver,
+            )
+            from poisson_ellipse_tpu.solver.engine import (
+                PRECOND_KIND_BY_ENGINE,
+            )
+
+            return build_mg_sharded_solver(
+                problem, mesh, dtype,
+                kind=PRECOND_KIND_BY_ENGINE[engine],
+            )
         solver, args = build_sharded_solver(
             problem, mesh, dtype, stencil_impl=engine
         )

@@ -1,0 +1,283 @@
+"""Mesh-sharded mg-pcg / cheb-pcg: the V-cycle under shard_map.
+
+The same classical sharded PCG loop as ``parallel.pcg_sharded`` — the
+scalar-collective cadence is UNTOUCHED: one denom psum plus ONE stacked
+convergence-word psum per iteration, exactly the classical discipline —
+with the preconditioner swapped for the layout-generic V-cycle /
+Chebyshev cores of ``mg`` running on per-shard blocks. Every piece of
+preconditioner communication is a nearest-neighbour halo exchange
+(``parallel.halo.halo_extend`` — 4 ``lax.ppermute``): Chebyshev steps
+pay one halo per stencil application, transfers one halo each (the
+9-point full-weighting gather and the odd-node bilinear straddle both
+reach exactly one cell across the shard edge). ``halos_per_precond``
+is the static budget; ``tests/test_mg.py`` pins the jaxpr's psum AND
+ppermute counts against it via ``obs.static_cost``.
+
+Level geometry: the fine node grid pads to a multiple of
+``(px·2^{L−1}, py·2^{L−1})`` so every level's shard block stays even
+and node-nested (coarse local (ic, jc) at fine local (2ic, 2jc) on the
+same device — coarsening never moves data between shards). Level
+coefficients are coarsened on the HOST in f64 from the same hierarchy
+the single-chip engine uses (``mg.coarsen.coefficient_hierarchy`` — one
+coarsening, two layouts), padded per level and laid out over the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from poisson_ellipse_tpu.mg import cheby, coarsen as mg_coarsen, vcycle
+from poisson_ellipse_tpu.mg.transfer import prolong_block, restrict_block
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.stencil import (
+    apply_a_block,
+    apply_dinv,
+    diag_d_block,
+)
+from poisson_ellipse_tpu.parallel.compat import shard_map
+from poisson_ellipse_tpu.parallel.halo import halo_extend
+from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y, make_mesh
+from poisson_ellipse_tpu.parallel.pcg_sharded import (
+    _shard_advance,
+    _shard_init,
+    _shard_ops,
+)
+from poisson_ellipse_tpu.solver.pcg import PCGResult
+
+
+def halos_per_precond(levels: int, nu: int = vcycle.DEFAULT_NU,
+                      coarse_degree: int = vcycle.DEFAULT_COARSE_DEGREE,
+                      ) -> int:
+    """Halo exchanges one preconditioner application costs (each is 4
+    ppermutes). Per non-coarsest level: ν−1 pre-smooth applies + 1
+    residual + 1 restrict + 1 prolong + ν post-smooth applies = 2ν+2;
+    coarsest: degree−1 applies. The static budget the jaxpr pin checks."""
+    if levels == 1:
+        return coarse_degree - 1
+    return (levels - 1) * (2 * nu + 2) + coarse_degree - 1
+
+
+def mg_padded_dims(problem: Problem, mesh: Mesh, levels: int,
+                   ) -> tuple[int, int]:
+    """Fine padded dims divisible by (px·2^{L−1}, py·2^{L−1}).
+
+    M divisible by 2^{L−1} (the level-count rule) makes the rounded-up
+    size automatically ≥ M + 2^{L−1}, so every level's padded grid
+    covers its node grid: g1p/2ˡ ≥ M/2ˡ + 1."""
+    px = mesh.shape[AXIS_X]
+    py = mesh.shape[AXIS_Y]
+    ux = px << (levels - 1)
+    uy = py << (levels - 1)
+    g1, g2 = problem.node_shape
+    return (-(-g1 // ux)) * ux, (-(-g2 // uy)) * uy
+
+
+def _interior_mask(Ml: int, Nl: int, gi, gj):
+    """Interior mask of a level's GLOBAL node grid at block indices
+    (zeros the Dirichlet ring and all shard padding)."""
+    return (
+        ((gi >= 1) & (gi <= Ml - 1))[:, None]
+        & ((gj >= 1) & (gj <= Nl - 1))[None, :]
+    )
+
+
+def build_mg_sharded_solver(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+    kind: str = "mg",
+    config=None,
+    history: bool = False,
+):
+    """(jitted solver_fn, args) for the mesh-sharded preconditioned solve.
+
+    ``kind`` "mg" (V-cycle) or "cheb" (degree-k polynomial). The
+    spectral interval comes from the same single-chip Lanczos probe the
+    single-chip engines use (the operator — and so its spectrum — is
+    mesh-independent), the hierarchy from the same host-f64 coarsening.
+    Args are the per-level (a, b) arrays plus the fine RHS, all padded
+    and laid out over the mesh.
+    """
+    from poisson_ellipse_tpu.mg.engine import resolve_config
+
+    if mesh is None:
+        mesh = make_mesh()
+    if kind not in ("mg", "cheb"):
+        raise ValueError(f"unknown preconditioner kind: {kind!r}")
+    a0, b0, rhs0 = assembly.assemble(problem, dtype)
+    cfg = config if config is not None else resolve_config(
+        problem, a0, b0, rhs0, kind
+    )
+    # a supplied config with the dataclass-default degenerate interval
+    # (lo=0.0) falls back to the Gershgorin interval instead of crashing
+    # the Chebyshev setup at trace time — same stance as mg.engine
+    lo, hi = cheby.clip_interval((cfg.lo, cfg.hi))
+    if (lo, hi) != (cfg.lo, cfg.hi):
+        cfg = dataclasses.replace(cfg, lo=lo, hi=hi)
+    levels = cfg.levels if kind == "mg" else 1
+    hier = mg_coarsen.coefficient_hierarchy(problem)[:levels]
+
+    px = mesh.shape[AXIS_X]
+    py = mesh.shape[AXIS_Y]
+    interpret = mesh.devices.flat[0].platform != "tpu"
+    g1p, g2p = mg_padded_dims(problem, mesh, levels)
+    bm, bn = g1p // px, g2p // py
+    spec = P(AXIS_X, AXIS_Y)
+    sharding = NamedSharding(mesh, spec)
+    np_dtype = assembly.numpy_dtype(dtype)
+
+    def _pad_to(arr, r, c):
+        return np.pad(arr, ((0, r - arr.shape[0]), (0, c - arr.shape[1])))
+
+    # fine operands + one (a, b) pair per level, each padded to its own
+    # level dims (divisible by the mesh by construction) and sharded
+    args = [
+        jax.device_put(
+            _pad_to(arr, g1p, g2p).astype(np_dtype), sharding
+        )
+        for arr in (hier[0]["a"], hier[0]["b"],
+                    assembly.assemble_numpy(problem)[2])
+    ]
+    for l in range(1, levels):
+        for key in ("a", "b"):
+            args.append(jax.device_put(
+                _pad_to(hier[l][key], g1p >> l, g2p >> l).astype(np_dtype),
+                sharding,
+            ))
+    args = tuple(args)
+
+    smooth_lo, smooth_hi = cheby.smoother_interval(cfg.hi)
+
+    def _make_precond(level_exts):
+        """Block-layout LevelOps from the halo-extended per-level
+        coefficient blocks, composed into the generic V-cycle core."""
+        ops = []
+        for l, (a_ext, b_ext) in enumerate(level_exts):
+            Ml, Nl = hier[l]["M"], hier[l]["N"]
+            h1 = jnp.asarray(hier[l]["h1"], dtype)
+            h2 = jnp.asarray(hier[l]["h2"], dtype)
+            bml, bnl = bm >> l, bn >> l
+            ix = lax.axis_index(AXIS_X)
+            iy = lax.axis_index(AXIS_Y)
+            gi = ix * bml + jnp.arange(bml, dtype=jnp.int32)
+            gj = iy * bnl + jnp.arange(bnl, dtype=jnp.int32)
+            mask = _interior_mask(Ml, Nl, gi, gj).astype(dtype)
+            d = jnp.where(
+                mask.astype(bool), diag_d_block(a_ext, b_ext, h1, h2), 0.0
+            )
+            last = l == len(level_exts) - 1
+
+            def make_apply(a_ext=a_ext, b_ext=b_ext, h1=h1, h2=h2,
+                           mask=mask):
+                return lambda x: (
+                    apply_a_block(halo_extend(x, px, py), a_ext, b_ext,
+                                  h1, h2) * mask
+                )
+
+            def make_dinv(d=d):
+                return lambda x: apply_dinv(x, d)
+
+            if last:
+                restrict = prolong = None
+            else:
+                Mc, Nc = hier[l + 1]["M"], hier[l + 1]["N"]
+                bmc, bnc = bml // 2, bnl // 2
+                gic = ix * bmc + jnp.arange(bmc, dtype=jnp.int32)
+                gjc = iy * bnc + jnp.arange(bnc, dtype=jnp.int32)
+                cmask = _interior_mask(Mc, Nc, gic, gjc).astype(dtype)
+
+                def restrict(r, cmask=cmask):
+                    return restrict_block(halo_extend(r, px, py)) * cmask
+
+                def prolong(ec, mask=mask, shape=(bml, bnl)):
+                    return prolong_block(
+                        halo_extend(ec, px, py), shape
+                    ) * mask
+
+            ops.append(vcycle.LevelOps(
+                apply_a=make_apply(),
+                dinv=make_dinv(),
+                smooth_lo=smooth_lo,
+                smooth_hi=cfg.hi,
+                solve_lo=min(cfg.lo * (4.0 ** l), smooth_hi / 4.0),
+                restrict=restrict,
+                prolong=prolong,
+            ))
+        if kind == "cheb":
+            fine = ops[0]
+            return lambda r: cheby.chebyshev_apply(
+                fine.apply_a, fine.dinv, r, cfg.lo, cfg.hi, cfg.cheb_degree
+            )
+        return vcycle.make_vcycle(
+            ops, nu=cfg.nu, coarse_degree=cfg.coarse_degree
+        )
+
+    out_specs = (spec, P(), P(), P(), P()) + ((P(),) * 4 if history else ())
+
+    def shard_fn(a_blk, b_blk, rhs_blk, *level_blks):
+        # one halo exchange per level's coefficients, once per SOLVE
+        # (the loop and the V-cycle reuse the extended blocks)
+        level_exts = [(halo_extend(a_blk, px, py),
+                       halo_extend(b_blk, px, py))]
+        for l in range(1, levels):
+            al, bl = level_blks[2 * (l - 1)], level_blks[2 * (l - 1) + 1]
+            level_exts.append((halo_extend(al, px, py),
+                               halo_extend(bl, px, py)))
+        precond = _make_precond(level_exts)
+        stencil, pdot, d = _shard_ops(
+            problem, px, py, bm, bn, level_exts[0][0], level_exts[0][1],
+            dtype, "xla", interpret,
+        )
+        state0 = _shard_init(
+            problem, px, py, bm, bn, pdot, d, rhs_blk, dtype,
+            history=history, precond=precond,
+        )
+        out = _shard_advance(
+            problem, stencil, pdot, d, state0, dtype, history=history,
+            precond=precond,
+        )
+        k, w = out[0], out[1]
+        diff, converged, breakdown = out[5], out[6], out[7]
+        return (w, k, diff, converged, breakdown) + tuple(out[8:])
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec,) * len(args),
+        out_specs=out_specs,
+    )
+
+    def solver(*arrays):
+        out = mapped(*arrays)
+        w_pad, k, diff, converged, breakdown = out[:5]
+        result = PCGResult(
+            w=w_pad[: problem.M + 1, : problem.N + 1],
+            iters=k,
+            diff=diff,
+            converged=converged,
+            breakdown=breakdown,
+        )
+        if history:
+            from poisson_ellipse_tpu.obs.convergence import trace_of
+
+            return result, trace_of(out[5:], k)
+        return result
+
+    return jax.jit(solver), args
+
+
+def solve_mg_sharded(problem: Problem, mesh: Mesh | None = None,
+                     dtype=jnp.float32, kind: str = "mg",
+                     history: bool = False):
+    """Assemble, shard and solve with the mesh V-cycle/Chebyshev."""
+    solver, args = build_mg_sharded_solver(
+        problem, mesh, dtype, kind=kind, history=history
+    )
+    return solver(*args)

@@ -103,17 +103,21 @@ def _shard_ops(problem: Problem, px: int, py: int, bm: int, bn: int,
 
 
 def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
-                pdot, d, rhs_blk, dtype, history: bool = False):
+                pdot, d, rhs_blk, dtype, history: bool = False,
+                precond=None):
     """The full PCG carry at iteration 0 on one shard — layout matches
     ``solver.pcg.init_state`` (k, w, r, p, zr, diff, converged,
     breakdown), with w/r/p as per-shard blocks and replicated scalars.
     ``history=True`` appends the four ``obs.convergence`` buffers —
-    scattered from psum-reduced scalars, so they stay replicated too."""
+    scattered from psum-reduced scalars, so they stay replicated too.
+    ``precond`` swaps the diagonal preconditioner for a per-shard
+    ``z = M⁻¹ r`` applier (``parallel.mg_sharded``'s V-cycle/Chebyshev
+    closures — halo ppermutes only, no scalar collectives)."""
     # the zeros literal is device-invariant; mark it varying over the mesh so
     # the while_loop carry type matches the (varying) per-device updates
     w0 = pcast_varying(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y))
     r0 = rhs_blk
-    z0 = apply_dinv(r0, d)
+    z0 = apply_dinv(r0, d) if precond is None else precond(r0)
     p0 = z0
     zr0 = pdot(z0, r0)
     state = (
@@ -132,13 +136,20 @@ def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
 
 
 def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
-                   limit=None, history: bool = False):
+                   limit=None, history: bool = False, precond=None):
     """Advance the sharded PCG carry until convergence/breakdown or
     iteration ``limit`` (defaults to max_iterations). Chunking only moves
     the while_loop boundary, not the arithmetic — same contract as
     ``solver.pcg.advance`` (including the history contract: recording is
     pure extra stores of already-psum-reduced scalars — no additional
-    collectives, no host traffic)."""
+    collectives, no host traffic).
+
+    ``precond`` replaces the diagonal preconditioner with a per-shard
+    ``z = M⁻¹ r`` applier; the scalar-collective cadence is untouched —
+    the convergence word stays the ONE stacked psum below, the denom
+    psum stays the other, and any preconditioner communication is halo
+    ppermutes inside ``precond`` itself (jaxpr-pinned in
+    ``tests/test_mg.py``)."""
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     delta = jnp.asarray(problem.delta, dtype)
@@ -164,7 +175,7 @@ def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
 
         w_new = w + alpha * p
         r_new = r - alpha * ap
-        z = apply_dinv(r_new, d)
+        z = apply_dinv(r_new, d) if precond is None else precond(r_new)
 
         # one collective for both scalars (vs 2 of the reference's 3
         # Allreduces; the denominator one above is inherently sequential)

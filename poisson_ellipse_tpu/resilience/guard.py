@@ -185,13 +185,23 @@ def _cast_carry(state, dtype):
 
 class _ClassicalAdapter:
     """The classical single-chip carry (``solver.pcg``), xla or pallas
-    stencil. Carry layout (k, w, r, p, zr, diff, converged, breakdown)."""
+    stencil. Carry layout (k, w, r, p, zr, diff, converged, breakdown).
+
+    ``precond_kind`` ("mg" / "cheb") runs the same carry with the
+    multigrid V-cycle / Chebyshev preconditioner (``mg.engine``) in the
+    ``z = M⁻¹ r`` slot — the guard's chunk/health/recover machinery is
+    preconditioner-agnostic because the carry layout is. Its fallback
+    ladder is mg-pcg → cheb-pcg → diag classical: a V-cycle poisoned by
+    a NaN in a coarse level degrades to the polynomial rung, then to
+    the reference preconditioner that every oracle is pinned against.
+    """
 
     FIELDS = {"w": 1, "r": 2, "p": 3, "zr": 4}
     K, ZR, DIFF, CONV, BD = 0, 4, 5, 6, 7
 
     def __init__(self, problem: Problem, dtype, stencil: str = "xla",
-                 interpret=None, operands=None):
+                 interpret=None, operands=None, precond_kind=None,
+                 precond_config=None):
         from poisson_ellipse_tpu.solver.pcg import (
             advance as pcg_advance,
             init_state as pcg_init_state,
@@ -201,18 +211,43 @@ class _ClassicalAdapter:
         self.dtype = dtype
         self.stencil = stencil
         self.interpret = interpret
-        self.engine = "xla" if stencil == "xla" else "pallas"
+        self.precond_kind = precond_kind
+        self._precond_cfg = None
+        if precond_kind is not None:
+            from poisson_ellipse_tpu.solver.engine import (
+                PRECOND_ENGINE_BY_KIND,
+            )
+
+            self.engine = PRECOND_ENGINE_BY_KIND[precond_kind]
+        else:
+            self.engine = "xla" if stencil == "xla" else "pallas"
         a, b, rhs = (
             operands if operands is not None
             else assembly.assemble(problem, dtype)
         )
         self._operands = (a, b, rhs)
+        if precond_kind is not None:
+            from poisson_ellipse_tpu.mg.engine import make_precond
+
+            # operands are shared so the build never re-assembles; the
+            # fallback path also hands the already-resolved spectral
+            # interval over (precond_config), skipping a second probe
+            factory, self._precond_cfg = make_precond(
+                problem, dtype, precond_kind, config=precond_config,
+                operands=(a, b, rhs),
+            )
+            precond = factory(a, b)
+        else:
+            precond = None
         self.rhs_norm = float(jnp.sqrt(jnp.sum(rhs.astype(jnp.float32) ** 2)))
-        self._init = lambda: pcg_init_state(problem, a, b, rhs)
+        self._init = lambda: pcg_init_state(
+            problem, a, b, rhs, precond=precond
+        )
         # the raw chunk closure IS the production advance — exposed
         # unjitted so tests can pin the guarded jaxpr against it
         self.advance_fn = lambda state, limit: pcg_advance(
-            problem, a, b, rhs, state, limit=limit, stencil=stencil
+            problem, a, b, rhs, state, limit=limit, stencil=stencil,
+            precond=precond,
         )
         # one compiled advance per adapter, the bound traced (no
         # recompile per chunk); carry not donated — the guard keeps the
@@ -225,10 +260,12 @@ class _ClassicalAdapter:
 
         def recover(state):
             # true residual restart KEEPING the search direction (the
-            # residual-replacement form — see module docstring)
+            # residual-replacement form — see module docstring); the
+            # rebuilt z goes through the SAME preconditioner, so the
+            # restarted recurrence still describes M⁻¹A
             k, w, _r, p, _zr, diff, _c, _bd = state[:8]
             r2 = rhs - apply_a(w, a, b, h1, h2)
-            z2 = apply_dinv(r2, d)
+            z2 = apply_dinv(r2, d) if precond is None else precond(r2)
             zr2 = grid_dot(z2, r2, h1, h2)
             p2 = jnp.where(jnp.all(jnp.isfinite(p)), p, z2)
             return (
@@ -259,6 +296,10 @@ class _ClassicalAdapter:
         return result_of(state)
 
     def escalate(self):
+        if self.precond_kind is not None:
+            # the preconditioner engines walk their own ladder
+            # (mg → cheb → diag, see fallback) before any dtype change
+            return None
         if self.stencil != "xla" or jnp.dtype(self.dtype).itemsize >= 8:
             return None
         if not jax.config.jax_enable_x64:
@@ -271,6 +312,32 @@ class _ClassicalAdapter:
         return adapter, lambda state: _cast_carry(state, jnp.float64)
 
     def fallback(self):
+        if self.precond_kind == "mg":
+            # the carry layout is shared, so the iterate/direction hand
+            # straight over; recover() rebuilds z/zr under the new M.
+            # The spectral interval is an operator property, not a
+            # preconditioner one: reuse the resolved bounds instead of
+            # re-running the Lanczos probe mid-recovery
+            import dataclasses as _dc
+
+            from poisson_ellipse_tpu.mg.engine import default_config
+
+            cheb_cfg = _dc.replace(
+                default_config(self.problem, "cheb"),
+                lo=self._precond_cfg.lo, hi=self._precond_cfg.hi,
+            )
+            adapter = _ClassicalAdapter(
+                self.problem, self.dtype, stencil="xla",
+                operands=self._operands, precond_kind="cheb",
+                precond_config=cheb_cfg,
+            )
+            return adapter, lambda state: state
+        if self.precond_kind == "cheb":
+            adapter = _ClassicalAdapter(
+                self.problem, self.dtype, stencil="xla",
+                operands=self._operands,
+            )
+            return adapter, lambda state: state
         if self.stencil == "pallas":
             adapter = _ClassicalAdapter(
                 self.problem, self.dtype, stencil="xla",
@@ -486,6 +553,13 @@ def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret):
         )
     if engine == "xla":
         return _ClassicalAdapter(problem, dtype, stencil="xla")
+    if engine in ("mg-pcg", "cheb-pcg"):
+        from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
+
+        return _ClassicalAdapter(
+            problem, dtype, stencil="xla",
+            precond_kind=PRECOND_KIND_BY_ENGINE[engine],
+        )
     if engine == "pallas":
         return _ClassicalAdapter(
             problem, dtype, stencil="pallas", interpret=interpret
@@ -525,11 +599,13 @@ def guarded_solve(
     interpret=None,
 ) -> GuardedResult:
     """Solve with failure detection and the recovery ladder (module
-    docstring). Loop engines (xla / pallas / pipelined / pipelined-pallas,
-    and the sharded classical stepper via ``mesh=``) run chunked with a
-    per-chunk health word; the VMEM mega-kernel engines (resident /
-    streamed / xl / fused, and ``auto``) run whole-solve with the
-    capacity-ladder fallback.
+    docstring). Loop engines (xla / pallas / pipelined / pipelined-pallas
+    / mg-pcg / cheb-pcg, and the sharded classical stepper via ``mesh=``)
+    run chunked with a per-chunk health word; the VMEM mega-kernel
+    engines (resident / streamed / xl / fused, and ``auto``) run
+    whole-solve with the capacity-ladder fallback. The preconditioner
+    engines walk their own fallback ladder — mg-pcg → cheb-pcg → the
+    diagonal classical loop — after the residual restart.
 
     ``timeout`` (seconds) is enforced at chunk boundaries — the cancel
     is graceful: the in-flight chunk completes, then
@@ -787,6 +863,9 @@ def _guarded_whole_solve(problem, engine, dtype, *, interpret, chunk,
         _check_deadline(timeout, t0, 0)
         try:
             _fire_whole_solve_oom(plan)
+            # one build per capacity rung is the whole-solve guard's
+            # fallback, bounded by the ladder
+            # tpulint: disable=TPU013
             solver, args, _ = build_solver(problem, cand, dtype, interpret)
             result = solver(*args)
             healthy = (

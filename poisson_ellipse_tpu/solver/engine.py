@@ -28,6 +28,13 @@ best path by default:
                masked updates + quarantine   amortised engine — batch.*)
   batched-     the same lanes through the    as above  (one stacked (8,B)
   pipelined    pipelined recurrence                    dot bundle/iter)
+  mg-pcg       classical loop, z = V-cycle   O(10¹)    (the iteration-
+               over coarsened coefficients   iters at  count killer —
+               w/ Chebyshev smoothers        any grid  mg.*; ~8× more
+                                                       HBM/iter)
+  cheb-pcg     classical loop, z = degree-k  ~k× fewer (the cheap first
+               Chebyshev polynomial in D⁻¹A  iters     rung; bounds from
+                                                       obs.spectrum)
 
 Policy (``select_engine``): resident if the whole working set fits VMEM;
 else streamed if the state fits; else xl. f64 always takes xla — the
@@ -68,7 +75,20 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 ENGINES = (
     "auto", "xla", "fused", "resident", "streamed", "xl", "pallas",
     "pipelined", "pipelined-pallas", "batched", "batched-pipelined",
+    "mg-pcg", "cheb-pcg",
 )
+
+# the preconditioner engines (mg.*): the classical fused loop with the
+# diagonal preconditioner swapped for the multigrid V-cycle / Chebyshev
+# polynomial — same PCGResult contract, O(grid)→O(1)-ish iteration
+# counts. "auto" never picks them: auto optimises per-iteration cost at
+# a FIXED iteration count (the oracle-checked diagonal recurrence);
+# these change the iteration count itself and are opt-in per run/bench.
+# The engine-name ↔ mg-kind mapping lives HERE, once — every consumer
+# (harness, guard, static_cost, mg.engine) imports it.
+PRECOND_KIND_BY_ENGINE = {"mg-pcg": "mg", "cheb-pcg": "cheb"}
+PRECOND_ENGINE_BY_KIND = {v: k for k, v in PRECOND_KIND_BY_ENGINE.items()}
+PRECOND_ENGINES = tuple(PRECOND_KIND_BY_ENGINE)
 
 # the lane-batched throughput engines (batch.*): one dispatch runs
 # ``lanes`` independent solves; results are per-lane (BatchedPCGResult)
@@ -82,6 +102,7 @@ BATCHED_ENGINES = ("batched", "batched-pipelined")
 # for every history consumer (harness diagnose, obs.spectrum callers).
 HISTORY_ENGINES = (
     "auto", "xla", "pallas", "fused", "pipelined", "pipelined-pallas",
+    "mg-pcg", "cheb-pcg",
 )
 
 
@@ -239,6 +260,15 @@ def build_solver(
         from poisson_ellipse_tpu.ops.xl_pcg import build_xl_solver
 
         solver, args = build_xl_solver(problem, dtype, interpret=interpret)
+    elif engine in PRECOND_ENGINES:
+        # the multigrid / Chebyshev preconditioned classical loop: the
+        # hierarchy + Lanczos bounds are resolved at build time, the
+        # V-cycle/polynomial runs inside the fused while_loop (mg.engine)
+        from poisson_ellipse_tpu.mg.engine import build_precond_solver
+
+        solver, args, _ = build_precond_solver(
+            problem, engine, dtype, history=history
+        )
     elif engine in ("pipelined", "pipelined-pallas"):
         from poisson_ellipse_tpu.ops.pipelined_pcg import pcg_pipelined
 

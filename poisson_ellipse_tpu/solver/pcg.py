@@ -54,7 +54,8 @@ class PCGResult(NamedTuple):
     breakdown: jax.Array
 
 
-def init_state(problem: Problem, a, b, rhs, history: bool = False):
+def init_state(problem: Problem, a, b, rhs, history: bool = False,
+               precond=None):
     """The PCG carry at iteration 0 (the resumable solver state).
 
     Layout: (k, w, r, p, zr, diff, converged, breakdown) — everything the
@@ -62,13 +63,17 @@ def init_state(problem: Problem, a, b, rhs, history: bool = False):
     (solver.checkpoint builds on this). With ``history=True`` the four
     ``obs.convergence`` buffers ((cap,) each) ride appended to the core
     carry; the core layout is untouched.
+
+    ``precond`` is the optional ``z = M⁻¹ r`` applier (a linear SPD
+    operator — the multigrid V-cycle / Chebyshev appliers of ``mg``);
+    None keeps the reference's diagonal preconditioner exactly.
     """
     dtype = rhs.dtype
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     d = diag_d(a, b, h1, h2)
     r0 = rhs
-    z0 = apply_dinv(r0, d)
+    z0 = apply_dinv(r0, d) if precond is None else precond(r0)
     zr0 = grid_dot(z0, r0, h1, h2)
     state = (
         jnp.asarray(0, jnp.int32),
@@ -86,7 +91,7 @@ def init_state(problem: Problem, a, b, rhs, history: bool = False):
 
 
 def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla",
-            history: bool = False):
+            history: bool = False, precond=None):
     """Advance the PCG carry until convergence/breakdown or iteration
     ``limit`` (defaults to max_iterations). Returns the new carry.
 
@@ -99,6 +104,10 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
     pure extra on-device stores, so the iterate trajectory is
     bit-identical to ``history=False`` (and with it off, the traced
     computation is exactly the historyless one: jaxpr-pinned).
+
+    ``precond`` swaps the diagonal preconditioner for an arbitrary
+    linear SPD ``z = M⁻¹ r`` applier (``mg``'s V-cycle / Chebyshev);
+    None traces exactly the historical diagonal loop.
     """
     dtype = rhs.dtype
     h1 = jnp.asarray(problem.h1, dtype)
@@ -125,6 +134,9 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
         raise ValueError(f"unknown stencil: {stencil!r}")
 
     d = diag_d(a, b, h1, h2)
+    apply_precond = (
+        (lambda r: apply_dinv(r, d)) if precond is None else precond
+    )
 
     def cond(state):
         k, converged, breakdown = state[0], state[6], state[7]
@@ -139,7 +151,7 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
 
         w_new = w + alpha * p
         r_new = r - alpha * ap
-        z = apply_dinv(r_new, d)
+        z = apply_precond(r_new)
 
         # ‖w^{k+1} − w^k‖ computed from the realised update (w_new − w), not
         # α·p, for bitwise parity with the reference's w/w_prev difference
@@ -193,7 +205,7 @@ def result_of(state) -> PCGResult:
 
 
 def pcg(problem: Problem, a, b, rhs, stencil: str = "xla",
-        history: bool = False):
+        history: bool = False, precond=None):
     """Run PCG for pre-assembled coefficients. All inputs (M+1, N+1).
 
     Jit-safe with ``problem`` static; the while_loop carries
@@ -207,10 +219,15 @@ def pcg(problem: Problem, a, b, rhs, stencil: str = "xla",
     history=True returns ``(PCGResult, obs.ConvergenceTrace)`` — the
     per-iteration (zr, diff, α, β) series captured on device with zero
     extra host syncs; the iterates are bit-identical either way.
+
+    precond: optional ``z = M⁻¹ r`` applier replacing the diagonal
+    preconditioner (see ``advance``; ``mg`` builds the V-cycle and
+    Chebyshev appliers this hook exists for).
     """
     state = advance(
-        problem, a, b, rhs, init_state(problem, a, b, rhs, history=history),
-        stencil=stencil, history=history,
+        problem, a, b, rhs,
+        init_state(problem, a, b, rhs, history=history, precond=precond),
+        stencil=stencil, history=history, precond=precond,
     )
     result = result_of(state)
     if history:

@@ -118,6 +118,51 @@ def test_throughput_drop_is_a_regression():
     ]
 
 
+def _precond_rows():
+    return [
+        {"grid": [100, 200], "engine": "mg-pcg", "iters": 30,
+         "t_solver_s": 0.2, "converged": True, "l2_error": 1e-4,
+         "diag_iters": 420, "diag_t_solver_s": 0.5,
+         "iters_reduction": 14.0, "speedup_vs_diag": 2.5},
+        {"grid": [100, 200], "engine": "cheb-pcg", "iters": 60,
+         "t_solver_s": 0.3, "converged": True, "l2_error": 1e-4},
+    ]
+
+
+def test_precond_regressions_are_named_per_grid_and_engine():
+    base = make_round(precond=_precond_rows())
+    assert regressions_between(base, base) == []
+    # iters are operator-determined: growth past the fractional band
+    # means the V-cycle/bounds broke, and the row names grid AND engine
+    new = make_round(precond=_precond_rows())
+    new["precond"][0]["iters"] = int(
+        30 * (1 + TOL["precond-iters-pct"]) * 1.1
+    )
+    assert regressions_between(base, new) == [
+        ("precond_iters", "100x200 mg-pcg")
+    ]
+    # the wall-clock win the key exists to defend
+    new = make_round(precond=_precond_rows())
+    new["precond"][1]["t_solver_s"] = 0.3 * (1 + TOL["precond-t-pct"]) * 1.05
+    assert regressions_between(base, new) == [
+        ("precond_t_solver_s", "100x200 cheb-pcg")
+    ]
+    # within tolerance / getting faster: silent
+    new = make_round(precond=_precond_rows())
+    new["precond"][0]["t_solver_s"] = 0.05
+    new["precond"][1]["iters"] = 58
+    assert regressions_between(base, new) == []
+
+
+def test_precond_only_in_one_round_is_noted_not_failed():
+    # pre-multigrid artifacts lack the key: skip with a note, never fail
+    old = make_round()
+    new = make_round(precond=_precond_rows())
+    regs, notes = bc.compare(old, new, TOL)
+    assert regs == []
+    assert any("precond" in n for n in notes)
+
+
 def test_null_kappa_in_a_matched_row_is_noted_not_silent():
     # bench_spectrum writes kappa=null when the trace was unusable —
     # exactly the broken-estimator case the gate exists to surface, so

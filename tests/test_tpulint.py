@@ -27,9 +27,9 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_twelve_rules():
+def test_registry_has_all_thirteen_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
-        "TPU010", "TPU011", "TPU012",
+        "TPU010", "TPU011", "TPU012", "TPU013",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -1302,6 +1302,87 @@ def test_tpu011_suppression_and_fence_allowlist_config():
     """
     assert codes_of(custom) == ["TPU011"]
     assert codes_of(custom, host_sync_fns=("my_sync",)) == []
+
+
+# -- TPU013: retraced levels (recursion / loop-varying factory calls) -------
+
+
+def test_tpu013_positive_recursive_jit_construction():
+    # the MG-levels hazard: a V-cycle recursing on the host and jitting
+    # per level traces a fresh callable every recursion step
+    src = """
+        import jax
+
+        def vcycle(levels, r):
+            if not levels:
+                return r
+            smooth = jax.jit(levels[0].smoother)
+            return vcycle(levels[1:], smooth(r))
+    """
+    # TPU006 owns the per-call-construction half of this fixture; the
+    # recursion angle is TPU013's — both must name the same site
+    assert codes_of(src) == ["TPU006", "TPU013"]
+
+
+def test_tpu013_positive_loop_varying_factory_call():
+    src = """
+        def run_levels(problem, levels):
+            for depth in levels:
+                solver, args = build_solver(problem, depth)
+                solver(*args)
+    """
+    assert codes_of(src) == ["TPU013"]
+
+
+def test_tpu013_negative_static_unrolled_recursion():
+    # the house pattern (mg.vcycle): Python recursion over a STATIC
+    # level list inside one traced function — no jit construction, no
+    # finding
+    src = """
+        def cycle(levels, l, r):
+            ops = levels[l]
+            if l == len(levels) - 1:
+                return ops.solve(r)
+            x = ops.smooth(r)
+            return x + ops.prolong(cycle(levels, l + 1, ops.restrict(r)))
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu013_negative_factory_and_warmup_scopes_exempt():
+    # a factory recursing through itself (the auto-engine chain) and a
+    # warm-up loop filling a pool are the deliberate build sites
+    src = """
+        import jax
+
+        def build_solver(problem, engine):
+            if engine == "auto":
+                return build_solver(problem, "xla")
+            return jax.jit(lambda x: x)
+
+        def warmup_pool(pool, grids):
+            for grid in grids:
+                pool[grid] = build_solver(grid, "xla")
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu013_negative_loop_invariant_factory_call_and_jax_helpers():
+    # a factory call whose arguments do not vary with the loop, and
+    # jax's own make_* in-trace helpers, both stay silent
+    src = """
+        from jax.experimental.pallas import tpu as pltpu
+
+        def drive(problem, reps):
+            solver, args = build_solver(problem, "xla")
+            for _ in range(reps):
+                solver(*args)
+
+        def kernel_body(ref, out):
+            for i in range(4):
+                pltpu.make_async_copy(ref, out, i).start()
+    """
+    assert codes_of(src) == []
 
 
 def test_suppression_is_per_code_not_blanket():

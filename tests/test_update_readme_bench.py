@@ -150,6 +150,44 @@ def test_spectrum_absent_or_failed_is_supported(workspace):
     assert "Spectral diagnostics" not in readme.read_text()
 
 
+def test_precond_table_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        precond=[
+            {"grid": [400, 600], "engine": "mg-pcg", "iters": 31,
+             "t_solver_s": 0.0123, "converged": True, "l2_error": 1e-4,
+             "diag_iters": 546, "diag_t_solver_s": 0.05,
+             "iters_reduction": 17.6, "speedup_vs_diag": 4.07},
+            {"grid": [800, 1200], "engine": "cheb-pcg", "iters": 90,
+             "t_solver_s": 0.02, "converged": True, "l2_error": 2e-5},
+        ]
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Preconditioning" in text
+    assert (
+        "| 400×600 | mg-pcg | 31 (diag 546) | **17.6× fewer** | "
+        "0.0123 s | 4.07× |" in text
+    )
+    # a row without the diag yardstick still renders, with dashes
+    assert "| 800×1200 | cheb-pcg | 90 | — | 0.0200 s | — |" in text
+
+
+def test_precond_absent_or_failed_is_supported(workspace):
+    # pre-multigrid artifacts lack the key; a failed row (the run
+    # aborted before an iteration count) is skipped, not a crash
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    assert "Preconditioning (`mg/`" not in readme.read_text()
+    artifact.write_text(json.dumps(make_artifact(
+        precond=[{"grid": [400, 600], "engine": "mg-pcg",
+                  "converged": False}]
+    )))
+    urb.regenerate(str(readme), str(artifact))
+    assert "Preconditioning (`mg/`" not in readme.read_text()
+
+
 def test_recovery_field_rendered_when_present(workspace):
     _tmp, readme, artifact = workspace
     rec = make_artifact(

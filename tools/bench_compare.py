@@ -23,6 +23,9 @@ older artifacts predate newer keys, which must never fail the gate):
   trace or the estimator broke, not the hardware)
 - `throughput` rows (keyed grid × lanes): `solves_per_sec` dropping
   more than `sps-pct`
+- `precond` rows (keyed grid × engine): `iters` growing more than
+  `precond-iters-pct` (operator-determined, like κ) or `t_solver_s`
+  more than `precond-t-pct` slower
 
 Tolerances live in `pyproject.toml [tool.bench_compare]` (shared by the
 CLI and the driver-dryrun smoke gate); built-in defaults apply when the
@@ -48,6 +51,11 @@ DEFAULT_TOLERANCES = {
     "gbps-pct": 0.25,
     "kappa-pct": 0.20,
     "sps-pct": 0.25,
+    # precond rows (mg-pcg/cheb-pcg): iteration counts are operator-
+    # determined like kappa but sit at O(10) where ±2 would be 20%, so
+    # they get a fractional band; time shares the wall-clock noise floor
+    "precond-iters-pct": 0.15,
+    "precond-t-pct": 0.25,
 }
 
 # scalar-row artifact keys carrying {grid, t_solver_s, iters}
@@ -234,6 +242,43 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
                 ))
     if bool(old.get("spectrum")) != bool(new.get("spectrum")):
         notes.append("spectrum: only in one round, skipped")
+
+    # preconditioner rows, keyed grid × engine: iteration counts are
+    # operator-determined (growth means the V-cycle/bounds broke, not
+    # the hardware — fractional band at their O(10) scale), t_solver is
+    # the wall-clock win the key exists to defend
+    def by_grid_engine(rows):
+        out = {}
+        for row in rows or []:
+            if row.get("grid") and row.get("engine"):
+                out[(tuple(row["grid"]), row["engine"])] = row
+        return out
+
+    old_pre = by_grid_engine(old.get("precond"))
+    new_pre = by_grid_engine(new.get("precond"))
+    for key in sorted(old_pre.keys() & new_pre.keys()):
+        o_row, n_row = old_pre[key], new_pre[key]
+        where_pre = f"{_grid_label(key[0])} {key[1]}"
+        o, n = o_row.get("iters"), n_row.get("iters")
+        if not one_sided("precond iters", where_pre, o, n) and o and \
+                n is not None:
+            limit = tol["precond-iters-pct"]
+            if n > o * (1.0 + limit):
+                regressions.append(Regression(
+                    "precond_iters", where_pre, o, n,
+                    f"+{(n / o - 1):.0%} > {limit:.0%} more iterations",
+                ))
+        o, n = o_row.get("t_solver_s"), n_row.get("t_solver_s")
+        if not one_sided("precond t_solver_s", where_pre, o, n) and o and \
+                n is not None:
+            limit = tol["precond-t-pct"]
+            if n > o * (1.0 + limit):
+                regressions.append(Regression(
+                    "precond_t_solver_s", where_pre, o, n,
+                    f"+{(n / o - 1):.0%} > {limit:.0%} slower",
+                ))
+    if bool(old.get("precond")) != bool(new.get("precond")):
+        notes.append("precond: only in one round, skipped")
 
     # serving throughput, keyed grid × lanes
     def by_grid_lanes(rows):

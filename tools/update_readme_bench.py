@@ -195,6 +195,9 @@ def table_block(rec: dict, src: str) -> str:
     obs = observability_lines(rec)
     if obs:
         lines += [""] + obs
+    precond = precond_lines(rec)
+    if precond:
+        lines += [""] + precond
     spectrum = spectrum_lines(rec)
     if spectrum:
         lines += [""] + spectrum
@@ -202,6 +205,44 @@ def table_block(rec: dict, src: str) -> str:
     if serving:
         lines += [""] + serving
     return "\n".join(lines)
+
+
+def precond_lines(rec: dict) -> list[str]:
+    """Markdown for the artifact's ``precond`` key (emitted by bench.py
+    since the multigrid layer landed): mg-pcg/cheb-pcg vs diag-PCG per
+    grid. Pre-multigrid artifacts lack the key and render without the
+    table; a failed row (no iters) is skipped, not a crash."""
+    rows = [
+        r for r in (rec.get("precond") or [])
+        if r.get("iters") and r.get("grid") and r.get("engine")
+    ]
+    if not rows:
+        return []
+    lines = [
+        "Preconditioning (`mg/`: geometric-multigrid V-cycle and "
+        "Chebyshev polynomial engines vs the reference's diagonal "
+        "preconditioner — the iteration-count wall, killed; "
+        "iters/T_solver regression-gated by `tools/bench_compare.py`):",
+        "",
+        "| Grid | engine | iters | vs diag iters | T_solver | vs diag |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        M, N = r["grid"]
+        red = (
+            f"**{r['iters_reduction']:g}× fewer**"
+            if r.get("iters_reduction") else "—"
+        )
+        diag_i = f" (diag {r['diag_iters']})" if r.get("diag_iters") else ""
+        vs = (
+            f"{r['speedup_vs_diag']:g}×"
+            if r.get("speedup_vs_diag") else "—"
+        )
+        lines.append(
+            f"| {M}×{N} | {r['engine']} | {r['iters']}{diag_i} | {red} | "
+            f"{fmt_t(r['t_solver_s'])} | {vs} |"
+        )
+    return lines
 
 
 def spectrum_lines(rec: dict) -> list[str]:
