@@ -43,6 +43,12 @@ checks):
                 continuous-batching scheduler (``serve.scheduler``,
                 chunk-boundary lane retire/refill) vs the static-batch
                 baseline — valid iff every request completes.
+  abft        — "abft" key: the silent-corruption checks' healthy-path
+                cost at 800×1200 — checks-on vs checks-off T_solver
+                (gate: ≤2% overhead) with the per-iteration collective
+                counts pinned IDENTICAL from the jaxpr (every checksum
+                partial rides the existing stacked convergence psum —
+                ``resilience.abft``).
 """
 
 from __future__ import annotations
@@ -584,6 +590,105 @@ def bench_recovery(grid: tuple[int, int] = (400, 600), oracle: int = 546):
     return row, ok
 
 
+# the ABFT healthy-path overhead gate: checks-on vs checks-off T_solver
+# at the headline grid (percent; tools/bench_compare.py diffs the
+# measured overhead between rounds under [tool.bench_compare] abft-pp)
+ABFT_OVERHEAD_GATE_PCT = 2.0
+
+
+def bench_abft(grid: tuple[int, int] = (800, 1200)):
+    """The ABFT key: the silent-corruption checks' healthy-path cost.
+
+    One sharded solve at the headline grid with ``abft=False`` and one
+    with ``abft=True`` (``parallel.pcg_sharded.build_sharded_stepper``),
+    both fenced and timed over the full solve. The contract this key
+    regression-pins: (1) collective counts per iteration are IDENTICAL
+    — every checksum partial rides the existing stacked convergence
+    psum, read from the jaxpr via ``obs.static_cost``; (2) the walltime
+    overhead of checks-on is ≤ 2% of T_solver (the extra work is fused
+    reductions over arrays the loop already touches). Single-device
+    environments skip (``available: false``) rather than fake a mesh.
+    """
+    if len(jax.devices()) < 2:
+        note("  [abft] fewer than 2 devices: overhead study skipped")
+        return {"available": False}, True
+    import jax.numpy as jnp
+
+    from poisson_ellipse_tpu.obs.static_cost import loop_collectives
+    from poisson_ellipse_tpu.parallel.mesh import make_mesh
+    from poisson_ellipse_tpu.parallel.pcg_sharded import (
+        build_sharded_stepper,
+    )
+
+    M, N = grid
+    problem = Problem(M=M, N=N)
+    mesh = make_mesh()
+    stats = {}
+    for abft in (False, True):
+        try:
+            init_fn, advance_fn = build_sharded_stepper(
+                problem, mesh, jnp.float32, abft=abft
+            )
+            state0 = init_fn()
+            # warm dispatch compiles the advance; the timed one is the
+            # steady-state full solve (fenced)
+            jax.block_until_ready(advance_fn(state0, 1))
+            t0 = time.perf_counter()
+            state = advance_fn(init_fn(), problem.max_iterations)
+            jax.block_until_ready(state)  # tpulint: disable=TPU011
+            t = time.perf_counter() - t0
+            psum, ppermute = loop_collectives(
+                advance_fn, (state0, problem.max_iterations)
+            )
+            stats[abft] = {
+                "t": t,
+                "iters": int(state[0]),
+                "converged": bool(state[6]),
+                "psum": psum,
+                "ppermute": ppermute,
+            }
+        except Exception as e:  # noqa: BLE001 — the study must never kill
+            # the artifact: the timing rows above already ran and must ship
+            note(f"  [abft] study failed ({type(e).__name__}: {e})")
+            return {"available": False, "error": str(e)}, True
+    off, on = stats[False], stats[True]
+    overhead_pct = (
+        (on["t"] - off["t"]) / off["t"] * 100.0 if off["t"] > 0 else 0.0
+    )
+    same_collectives = (
+        off["psum"] == on["psum"] and off["ppermute"] == on["ppermute"]
+    )
+    ok = (
+        off["converged"] and on["converged"]
+        and abs(on["iters"] - off["iters"]) <= 1
+        and same_collectives
+        and overhead_pct <= ABFT_OVERHEAD_GATE_PCT
+    )
+    row = {
+        "available": True,
+        "grid": [M, N],
+        "mesh": [int(mesh.shape[a]) for a in mesh.axis_names],
+        "t_off_s": round(off["t"], 5),
+        "t_on_s": round(on["t"], 5),
+        "overhead_pct": round(overhead_pct, 3),
+        "gate_pct": ABFT_OVERHEAD_GATE_PCT,
+        "iters_off": off["iters"],
+        "iters_on": on["iters"],
+        "psum_per_iter": on["psum"],
+        "ppermute_per_iter": on["ppermute"],
+        "collectives_identical": same_collectives,
+        "ok": ok,
+    }
+    note(
+        f"  [abft] {M}x{N}: off {off['t']:.4f}s, on {on['t']:.4f}s "
+        f"-> {overhead_pct:+.2f}% (gate {ABFT_OVERHEAD_GATE_PCT:.0f}%), "
+        f"psum/iter {off['psum']}->{on['psum']}, "
+        f"ppermute/iter {off['ppermute']}->{on['ppermute']} "
+        + ("— OK" if ok else "— GATE MISS"),
+    )
+    return row, ok
+
+
 THROUGHPUT_LANES = (1, 8, 32)
 THROUGHPUT_GRIDS = ((400, 600, 546), (800, 1200, 989))
 
@@ -864,9 +969,12 @@ def main() -> int:
     # resilience row: an injected NaN mid-solve must recover to oracle
     # parity through the guard (f32, before the f64 flip below)
     rec_row, okr = bench_recovery()
+    # ABFT overhead study: silent-corruption checks on vs off — ≤2%
+    # T_solver and identical collective counts (f32, pre-f64-flip)
+    abft_row, oka = bench_abft()
     all_ok &= (
         ok2 & okn & ok8 & okp & okpc & okt & okcs & oksv & oke & okc & okl
-        & oks & okr
+        & oks & okr & oka
     )
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
@@ -915,6 +1023,9 @@ def main() -> int:
         # guarded-solve fault drill: injected NaN -> residual restart ->
         # oracle-parity reconvergence (resilience.guard)
         "recovery": rec_row,
+        # ABFT silent-corruption checks: healthy-path overhead (≤2%
+        # gate) with the 1-psum/iter cadence pinned identical on vs off
+        "abft": abft_row,
         "f64": f64_row,
     }
     trace_event("bench_artifact", **record)

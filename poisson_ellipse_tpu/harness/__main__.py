@@ -95,7 +95,10 @@ EXIT_CODES_HELP = (
     "(also invalid invocations, per argparse convention); 3 device "
     "out-of-memory with no engine left to degrade to; 4 --timeout "
     "exceeded (partial trace artifact emitted); 5 shed at admission by "
-    "the serving layer (backpressure — resubmit after retry_after_s)."
+    "the serving layer (backpressure — resubmit after retry_after_s); "
+    "6 silent data corruption detected by the ABFT checks and not "
+    "cleared by rollback-and-rerun (persistent SDC source); 7 mesh "
+    "device lost with no degraded mesh left to resume on."
 )
 
 
@@ -221,7 +224,13 @@ def _run_inject(argv: list[str]) -> int:
         "recovery ladder's actions. " + EXIT_CODES_HELP,
     )
     ap.add_argument(
-        "fault", choices=sorted(faultinject.FAULT_KINDS),
+        "fault",
+        # device_loss/straggler are mesh-level dispatch faults — they
+        # belong to the meshguard/chaos drills, not the single-solve
+        # guard this subcommand runs
+        choices=sorted(
+            set(faultinject.FAULT_KINDS) - {"device_loss", "straggler"}
+        ),
         help="fault class to inject (see resilience.faultinject)",
     )
     ap.add_argument("M", type=int, nargs="?", default=40)
@@ -827,6 +836,14 @@ def _run_chaos(argv: list[str]) -> int:
         help="skip the kill/restart (fault injection only)",
     )
     ap.add_argument(
+        "--mesh", action="store_true",
+        help="add the mesh-kill drill: a simulated device loss takes "
+        "out every live batch carry mid-stream and every in-flight "
+        "request must re-enter through the journal/retry ladder — the "
+        "zero-lost/zero-double invariants asserted across a DEVICE "
+        "kill, not just a process kill",
+    )
+    ap.add_argument(
         "--journal", metavar="FILE",
         help="journal path (default: a temp file, removed after)",
     )
@@ -860,6 +877,9 @@ def _run_chaos(argv: list[str]) -> int:
                 journal_path=journal,
                 kill_after=None if not args.no_kill else 0,
                 deadline_s=args.deadline,
+                mesh_kill_request=(
+                    max(args.requests // 3, 1) if args.mesh else None
+                ),
             )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
@@ -871,11 +891,14 @@ def _run_chaos(argv: list[str]) -> int:
             print(json.dumps(report.json_dict()))
         else:
             verdict = "OK" if report.ok else "INVARIANT VIOLATION"
+            mesh_note = (
+                "; mesh-kill drill fired" if report.mesh_killed else ""
+            )
             print(
                 f"chaos: {report.n_requests} requests, seed {args.seed} — "
                 f"{verdict}; outcomes {report.counts}; "
                 f"replayed {report.replayed} after kill; "
-                f"{report.faults_fired} faults fired; "
+                f"{report.faults_fired} faults fired{mesh_note}; "
                 f"lost {len(report.lost)}, doubled "
                 f"{len(report.double_completed)} ({report.wall_s:.2f}s)"
             )

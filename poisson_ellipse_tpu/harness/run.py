@@ -457,7 +457,17 @@ def run_once(
             fence(out)  # tpulint: disable=TPU008
             tbs.append(time.perf_counter() - t0)
         t1 = statistics.median(t1s)
-        times = [max(tb - t1, 0.0) / (batch - 1) for tb in tbs]
+        # Noise floor: under host-load jitter a chained dispatch can
+        # measure FASTER than the single one (tb ≤ t1), collapsing the
+        # marginal estimate to 0 — a meaningless T_solver that poisons
+        # every derived rate (solves/sec → None, GB/s → inf). Fall back
+        # to the chained per-dispatch cost for those samples: an upper
+        # bound on the marginal cost, strictly positive, and exactly
+        # equal in the noise-free regime the protocol targets.
+        times = [
+            (tb - t1) / (batch - 1) if tb > t1 else tb / batch
+            for tb in tbs
+        ]
     else:
         times = []
         for _ in range(repeat):

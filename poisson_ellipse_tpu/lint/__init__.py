@@ -157,6 +157,9 @@ def load_config(root: Optional[str] = None) -> LintConfig:
         aot_warmup_fns=tuple(
             table.get("aot-warmup-fns", cfg.aot_warmup_fns)
         ),
+        retry_backoff_fns=tuple(
+            table.get("retry-backoff-fns", cfg.retry_backoff_fns)
+        ),
     )
 
 

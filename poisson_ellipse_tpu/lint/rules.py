@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU013.
+"""The tpulint rule registry: TPU001–TPU014.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -44,6 +44,12 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | the MG-level recompile hazard: level count    |
 |        |                    | must be static per grid bucket (TPU010's      |
 |        |                    | factory-call sibling)                         |
+| TPU014 | retry-without-     | an unbounded `while True` retry loop whose    |
+|        | backoff            | exception handler swallows-and-loops with     |
+|        |                    | neither a backoff/sleep call nor an attempt   |
+|        |                    | cap in sight — the hot-spin retry storm that  |
+|        |                    | turns one failing dispatch into a pegged host |
+|        |                    | and a hammered runtime                        |
 """
 
 from __future__ import annotations
@@ -101,6 +107,13 @@ class LintConfig:
     # in a loop there is the *fix* for recompile hazards, not one.
     # jit_factory_patterns are exempt as well (build-once contract).
     aot_warmup_fns: tuple[str, ...] = ("warmup*", "precompile*")
+    # TPU014: backoff-style callables (leaf-name/qualname fnmatch
+    # patterns). A retry loop that calls one of these between attempts
+    # is pacing itself; one that calls none AND carries no attempt cap
+    # is the hot-spin retry storm the rule exists to fence.
+    retry_backoff_fns: tuple[str, ...] = (
+        "*sleep*", "*backoff*", "idle", "*.idle", "wait", "*.wait",
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1781,3 +1794,124 @@ def check_swallowed_exception(module: Module, config: LintConfig) -> Iterator[Fi
                 "helper, or suppress with a note when the swallow is "
                 "deliberate",
             )
+
+
+# --------------------------------------------------------------------------
+# TPU014 — unbounded retry loops with neither backoff nor an attempt cap
+# --------------------------------------------------------------------------
+
+
+def _walk_same_scope(root: ast.AST):
+    """Walk a subtree WITHOUT descending into nested function/class
+    definitions — their loops and handlers belong to their own scope."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _handler_retries(handler: ast.ExceptHandler) -> bool:
+    """True when the handler swallows and lets the loop spin again: no
+    raise, no return, no break anywhere in its body (a `continue` or a
+    plain fall-through both re-enter the loop)."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return False
+    return True
+
+
+def _is_backoff_call(module: Module, node: ast.Call,
+                     config: LintConfig) -> bool:
+    if isinstance(node.func, ast.Name):
+        leaf = node.func.id
+    elif isinstance(node.func, ast.Attribute):
+        leaf = node.func.attr
+    else:
+        return False
+    q = module.qualname(node.func) or leaf
+    return any(
+        fnmatch.fnmatch(leaf, pat) or fnmatch.fnmatch(q, pat)
+        for pat in config.retry_backoff_fns
+    )
+
+
+def _has_capped_exit(loop: ast.While) -> bool:
+    """True when the loop carries a recognizable attempt cap: an `if`
+    whose test is a comparison and whose body OR else-arm exits the
+    loop (raise / return / break) — both the `if attempt > budget:
+    raise` shape and its inverted `if attempt <= budget: continue /
+    else: raise` spelling."""
+    for node in _walk_same_scope(loop):
+        if not isinstance(node, ast.If):
+            continue
+        if not isinstance(node.test, ast.Compare):
+            continue
+        for stmt in node.body + node.orelse:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Raise, ast.Return, ast.Break)):
+                    return True
+    return False
+
+
+@rule(
+    "TPU014",
+    "retry-without-backoff",
+    "unbounded `while True` retry loop whose handler swallows-and-loops "
+    "with neither a backoff call nor an attempt cap",
+)
+def check_retry_without_backoff(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """The retry-storm fence. A serving stack retries by design — the
+    scheduler's ladder, the guard's recovery budget — but every one of
+    those sites is *paced* (exponential backoff through a sleep/idle
+    callable) or *capped* (`attempt > budget` raising a classified
+    error). A `while True:` whose `except` swallows the failure and
+    loops again with neither is the pattern that turns one failing
+    dispatch into a pegged host core and a hammered device runtime —
+    and, at pod scale, one sick worker into a thundering herd.
+
+    Conservative by construction: only constant-true `while` loops are
+    considered (a tested loop condition is itself a bound); a handler
+    "retries" only when its body has no raise/return/break at all; any
+    call matching ``retry-backoff-fns`` (``[tool.tpulint]``) counts as
+    pacing, and any compare-guarded raise/return/break as a cap.
+    Worklist-draining loops whose retry consumes state (the checkpoint
+    quarantine walk) carry an annotation saying so, like every other
+    waived finding.
+    """
+    for loop in ast.walk(module.tree):
+        if not isinstance(loop, ast.While):
+            continue
+        test = loop.test
+        if not (isinstance(test, ast.Constant) and bool(test.value)):
+            continue  # a real condition is a bound; out of scope
+        retrying = [
+            handler
+            for node in _walk_same_scope(loop)
+            if isinstance(node, ast.Try)
+            for handler in node.handlers
+            if _handler_retries(handler)
+        ]
+        if not retrying:
+            continue
+        paced = any(
+            isinstance(node, ast.Call)
+            and _is_backoff_call(module, node, config)
+            for node in _walk_same_scope(loop)
+        )
+        if paced or _has_capped_exit(loop):
+            continue
+        yield _finding(
+            module,
+            retrying[0],
+            "TPU014",
+            "`while True` retry: this handler swallows the failure and "
+            "loops again with no backoff call and no attempt cap — a "
+            "failing dispatch becomes a hot spin. Pace it (retry-"
+            "backoff-fns), cap it (`if attempt > budget: raise`), or "
+            "suppress with a note when the retry consumes a finite "
+            "worklist",
+        )

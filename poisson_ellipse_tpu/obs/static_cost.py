@@ -119,6 +119,19 @@ def loop_primitive_counts(
     return merged
 
 
+def loop_collectives(fn, args) -> tuple[int, int]:
+    """(psum, ppermute) per iteration, with the ``psum_invariant``
+    spelling folded into psum (one collective on the wire). The compact
+    form every cadence pin compares — the ABFT checks-on-vs-off
+    equality in ``tests/test_elastic.py`` and the ``abft`` bench key
+    both assert on exactly this pair."""
+    counts = loop_primitive_counts(fn, args)
+    return (
+        counts.get("psum", 0) + counts.get("psum_invariant", 0),
+        counts.get("ppermute", 0),
+    )
+
+
 # -- XLA cost analysis -------------------------------------------------------
 
 

@@ -1,0 +1,114 @@
+"""Elastic mesh surgery: shrink a device mesh and re-shard a live carry.
+
+The reference's MPI stages die wholesale when any rank fails
+(``MPI_Init``/``Finalize`` with no recovery surface — ``parallel.
+multihost``'s docstring); XLA's SPMD programs are no kinder — a lost
+device invalidates every array laid out over the mesh. What CAN survive
+is the *state*: the PCG carry is a handful of global arrays plus
+replicated scalars, and the solve's arithmetic is mesh-independent
+(decomposition only changes the f.p. reduction grouping, an ulp-scale
+effect pinned by the sharded-parity tests). So elasticity is three small
+operations, all off the hot path:
+
+- :func:`surviving_devices` / :func:`shrink_mesh` — rebuild the 2D mesh
+  over whatever devices remain, factored near-square exactly like the
+  original (``parallel.mesh.choose_process_grid``), so a 2×2 mesh that
+  loses two devices resumes as 1×2, and one that loses a single device
+  resumes 1×3.
+- :func:`gather_state` — pull a sharded carry to host numpy (the only
+  layout that survives the old mesh's death).
+- :func:`reshard_state` — crop the old mesh's shard padding back to the
+  node grid, re-pad to the NEW decomposition's even-shard dims (the same
+  padding rule every sharded build uses — zero coefficients, exterior-
+  Dirichlet behaviour), and lay the arrays out over the new mesh.
+
+``resilience.meshguard`` composes these with the durable checkpoint
+(``solver.checkpoint`` re-shards on resume via the same functions) into
+the degraded-mesh recovery ladder; ``resilience.guard`` uses
+:func:`reshard_state` to hand a preconditioned mesh carry (whose level
+geometry pads differently) over to the classical stepper on fallback.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.parallel.mesh import (
+    AXIS_X,
+    AXIS_Y,
+    make_mesh,
+    padded_dims,
+)
+from poisson_ellipse_tpu.resilience.errors import DeviceLossError
+
+
+def surviving_devices(mesh: Mesh, lost_ids) -> list:
+    """The mesh's devices minus the lost ids (order preserved)."""
+    lost = set(lost_ids)
+    return [d for d in mesh.devices.flat if d.id not in lost]
+
+
+def shrink_mesh(mesh: Mesh, lost_ids) -> Mesh:
+    """A fresh near-square 2D mesh over the survivors.
+
+    Raises the classified :class:`DeviceLossError` when nothing
+    survives — the ladder's hard floor."""
+    survivors = surviving_devices(mesh, lost_ids)
+    if not survivors:
+        raise DeviceLossError(
+            f"all {mesh.devices.size} mesh devices lost ({sorted(set(lost_ids))})"
+            " — no degraded mesh remains to resume on"
+        )
+    return make_mesh(survivors)
+
+
+def gather_state(state) -> tuple:
+    """A sharded carry as host numpy (scalars stay 0-d arrays)."""
+    return tuple(np.asarray(x) for x in state)
+
+
+def reshard_state(
+    problem: Problem,
+    state,
+    mesh: Mesh,
+    dtype,
+    dims: tuple[int, int] | None = None,
+):
+    """Re-lay a classical 8-field carry out over ``mesh``.
+
+    Grid fields (ndim == 2) are cropped to the node grid — dropping the
+    OLD decomposition's zero padding, whatever it was — then zero-padded
+    to ``dims`` (default: the new mesh's even-shard dims) and placed
+    P('x','y'); scalars replicate. The padding carries zeros into fields
+    that are zero there by construction (every sharded iterate is
+    interior-masked), so a resharded carry advances exactly as the
+    original decomposition's would, modulo psum reduction grouping (an
+    ulp-scale reordering — the parity contract the tests pin).
+
+    Any ABFT shadow tail is deliberately NOT accepted here: shadow sums
+    must be re-anchored against the resharded arrays (the stepper's
+    recover / a fresh anchor), never copied across a layout change.
+    """
+    if len(state) != 8:
+        raise ValueError(
+            f"reshard_state takes the classical 8-field carry, got "
+            f"{len(state)} fields (strip/re-anchor any ABFT or history tail)"
+        )
+    g1, g2 = problem.node_shape
+    g1p, g2p = padded_dims(problem.node_shape, mesh) if dims is None else dims
+    grid_sharding = NamedSharding(mesh, P(AXIS_X, AXIS_Y))
+    scalar_sharding = NamedSharding(mesh, P())
+    out = []
+    for x in gather_state(state):
+        if x.ndim == 2:
+            cropped = x[:g1, :g2]
+            padded = np.pad(
+                cropped, ((0, g1p - g1), (0, g2p - g2))
+            ).astype(x.dtype)
+            out.append(jax.device_put(padded, grid_sharding))
+        else:
+            out.append(jax.device_put(x, scalar_sharding))
+    return tuple(out)

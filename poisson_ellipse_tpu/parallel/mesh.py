@@ -97,9 +97,17 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices).reshape(px, py), (AXIS_X, AXIS_Y))
 
 
+def padded_dims_of(problem_nodes: tuple[int, int], px: int,
+                   py: int) -> tuple[int, int]:
+    """Global node-grid dims padded up to multiples of (px, py) — the
+    shape-only form, usable when the mesh itself no longer exists (a
+    checkpoint written by a dead mesh still names its shape)."""
+    g1, g2 = problem_nodes
+    return (-(-g1 // px) * px, -(-g2 // py) * py)
+
+
 def padded_dims(problem_nodes: tuple[int, int], mesh: Mesh) -> tuple[int, int]:
     """Global node-grid dims padded up to multiples of the mesh shape."""
-    g1, g2 = problem_nodes
-    px = mesh.shape[AXIS_X]
-    py = mesh.shape[AXIS_Y]
-    return (-(-g1 // px) * px, -(-g2 // py) * py)
+    return padded_dims_of(
+        problem_nodes, mesh.shape[AXIS_X], mesh.shape[AXIS_Y]
+    )

@@ -88,76 +88,88 @@ def _interior_mask(Ml: int, Nl: int, gi, gj):
     )
 
 
-def build_mg_sharded_solver(
-    problem: Problem,
-    mesh: Mesh | None = None,
-    dtype=jnp.float32,
-    kind: str = "mg",
-    config=None,
-    history: bool = False,
-):
-    """(jitted solver_fn, args) for the mesh-sharded preconditioned solve.
+class _MgShardSetup:
+    """Everything the mesh-preconditioned loop needs, factored once so
+    the whole-solve form and the chunked stepper (the guard's resumable
+    surface) cannot drift: level operands laid out over the mesh, the
+    per-shard precond factory, and the geometry."""
 
-    ``kind`` "mg" (V-cycle) or "cheb" (degree-k polynomial). The
-    spectral interval comes from the same single-chip Lanczos probe the
-    single-chip engines use (the operator — and so its spectrum — is
-    mesh-independent), the hierarchy from the same host-f64 coarsening.
-    Args are the per-level (a, b) arrays plus the fine RHS, all padded
-    and laid out over the mesh.
-    """
-    from poisson_ellipse_tpu.mg.engine import resolve_config
+    def __init__(self, problem: Problem, mesh: Mesh, dtype, kind: str,
+                 config):
+        from poisson_ellipse_tpu.mg.engine import resolve_config
 
-    if mesh is None:
-        mesh = make_mesh()
-    if kind not in ("mg", "cheb"):
-        raise ValueError(f"unknown preconditioner kind: {kind!r}")
-    a0, b0, rhs0 = assembly.assemble(problem, dtype)
-    cfg = config if config is not None else resolve_config(
-        problem, a0, b0, rhs0, kind
-    )
-    # a supplied config with the dataclass-default degenerate interval
-    # (lo=0.0) falls back to the Gershgorin interval instead of crashing
-    # the Chebyshev setup at trace time — same stance as mg.engine
-    lo, hi = cheby.clip_interval((cfg.lo, cfg.hi))
-    if (lo, hi) != (cfg.lo, cfg.hi):
-        cfg = dataclasses.replace(cfg, lo=lo, hi=hi)
-    levels = cfg.levels if kind == "mg" else 1
-    hier = mg_coarsen.coefficient_hierarchy(problem)[:levels]
-
-    px = mesh.shape[AXIS_X]
-    py = mesh.shape[AXIS_Y]
-    interpret = mesh.devices.flat[0].platform != "tpu"
-    g1p, g2p = mg_padded_dims(problem, mesh, levels)
-    bm, bn = g1p // px, g2p // py
-    spec = P(AXIS_X, AXIS_Y)
-    sharding = NamedSharding(mesh, spec)
-    np_dtype = assembly.numpy_dtype(dtype)
-
-    def _pad_to(arr, r, c):
-        return np.pad(arr, ((0, r - arr.shape[0]), (0, c - arr.shape[1])))
-
-    # fine operands + one (a, b) pair per level, each padded to its own
-    # level dims (divisible by the mesh by construction) and sharded
-    args = [
-        jax.device_put(
-            _pad_to(arr, g1p, g2p).astype(np_dtype), sharding
+        if kind not in ("mg", "cheb"):
+            raise ValueError(f"unknown preconditioner kind: {kind!r}")
+        a0, b0, rhs0 = assembly.assemble(problem, dtype)
+        cfg = config if config is not None else resolve_config(
+            problem, a0, b0, rhs0, kind
         )
-        for arr in (hier[0]["a"], hier[0]["b"],
-                    assembly.assemble_numpy(problem)[2])
-    ]
-    for l in range(1, levels):
-        for key in ("a", "b"):
-            args.append(jax.device_put(
-                _pad_to(hier[l][key], g1p >> l, g2p >> l).astype(np_dtype),
-                sharding,
-            ))
-    args = tuple(args)
+        # a supplied config with the dataclass-default degenerate interval
+        # (lo=0.0) falls back to the Gershgorin interval instead of
+        # crashing the Chebyshev setup at trace time — same stance as
+        # mg.engine
+        lo, hi = cheby.clip_interval((cfg.lo, cfg.hi))
+        if (lo, hi) != (cfg.lo, cfg.hi):
+            cfg = dataclasses.replace(cfg, lo=lo, hi=hi)
+        self.problem = problem
+        self.mesh = mesh
+        self.dtype = dtype
+        self.kind = kind
+        self.cfg = cfg
+        self.levels = cfg.levels if kind == "mg" else 1
+        self.hier = mg_coarsen.coefficient_hierarchy(problem)[:self.levels]
+        self.px = mesh.shape[AXIS_X]
+        self.py = mesh.shape[AXIS_Y]
+        self.interpret = mesh.devices.flat[0].platform != "tpu"
+        self.g1p, self.g2p = mg_padded_dims(problem, mesh, self.levels)
+        self.bm, self.bn = self.g1p // self.px, self.g2p // self.py
+        self.spec = P(AXIS_X, AXIS_Y)
+        sharding = NamedSharding(mesh, self.spec)
+        np_dtype = assembly.numpy_dtype(dtype)
 
-    smooth_lo, smooth_hi = cheby.smoother_interval(cfg.hi)
+        def _pad_to(arr, r, c):
+            return np.pad(
+                arr, ((0, r - arr.shape[0]), (0, c - arr.shape[1]))
+            )
 
-    def _make_precond(level_exts):
+        # fine operands + one (a, b) pair per level, each padded to its
+        # own level dims (divisible by the mesh by construction), sharded
+        args = [
+            jax.device_put(
+                _pad_to(arr, self.g1p, self.g2p).astype(np_dtype), sharding
+            )
+            for arr in (self.hier[0]["a"], self.hier[0]["b"],
+                        assembly.assemble_numpy(problem)[2])
+        ]
+        for l in range(1, self.levels):
+            for key in ("a", "b"):
+                args.append(jax.device_put(
+                    _pad_to(
+                        self.hier[l][key], self.g1p >> l, self.g2p >> l
+                    ).astype(np_dtype),
+                    sharding,
+                ))
+        self.args = tuple(args)
+        self.smooth_lo, self.smooth_hi = cheby.smoother_interval(cfg.hi)
+
+    def extend_levels(self, a_blk, b_blk, level_blks):
+        """One halo exchange per level's coefficients, once per dispatch
+        (the loop and the V-cycle reuse the extended blocks)."""
+        px, py = self.px, self.py
+        level_exts = [(halo_extend(a_blk, px, py),
+                       halo_extend(b_blk, px, py))]
+        for l in range(1, self.levels):
+            al, bl = level_blks[2 * (l - 1)], level_blks[2 * (l - 1) + 1]
+            level_exts.append((halo_extend(al, px, py),
+                               halo_extend(bl, px, py)))
+        return level_exts
+
+    def make_precond(self, level_exts):
         """Block-layout LevelOps from the halo-extended per-level
         coefficient blocks, composed into the generic V-cycle core."""
+        px, py, bm, bn = self.px, self.py, self.bm, self.bn
+        hier, cfg, dtype, kind = self.hier, self.cfg, self.dtype, self.kind
+        smooth_lo, smooth_hi = self.smooth_lo, self.smooth_hi
         ops = []
         for l, (a_ext, b_ext) in enumerate(level_exts):
             Ml, Nl = hier[l]["M"], hier[l]["N"]
@@ -219,19 +231,38 @@ def build_mg_sharded_solver(
             ops, nu=cfg.nu, coarse_degree=cfg.coarse_degree
         )
 
+
+def build_mg_sharded_solver(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+    kind: str = "mg",
+    config=None,
+    history: bool = False,
+):
+    """(jitted solver_fn, args) for the mesh-sharded preconditioned solve.
+
+    ``kind`` "mg" (V-cycle) or "cheb" (degree-k polynomial). The
+    spectral interval comes from the same single-chip Lanczos probe the
+    single-chip engines use (the operator — and so its spectrum — is
+    mesh-independent), the hierarchy from the same host-f64 coarsening.
+    Args are the per-level (a, b) arrays plus the fine RHS, all padded
+    and laid out over the mesh.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    setup = _MgShardSetup(problem, mesh, dtype, kind, config)
+    px, py, bm, bn = setup.px, setup.py, setup.bm, setup.bn
+    interpret = setup.interpret
+    spec = setup.spec
+    args = setup.args
+
     out_specs = (spec, P(), P(), P(), P()) + ((P(),) * 4 if history else ())
 
     def shard_fn(a_blk, b_blk, rhs_blk, *level_blks):
-        # one halo exchange per level's coefficients, once per SOLVE
-        # (the loop and the V-cycle reuse the extended blocks)
-        level_exts = [(halo_extend(a_blk, px, py),
-                       halo_extend(b_blk, px, py))]
-        for l in range(1, levels):
-            al, bl = level_blks[2 * (l - 1)], level_blks[2 * (l - 1) + 1]
-            level_exts.append((halo_extend(al, px, py),
-                               halo_extend(bl, px, py)))
-        precond = _make_precond(level_exts)
-        stencil, pdot, d = _shard_ops(
+        level_exts = setup.extend_levels(a_blk, b_blk, level_blks)
+        precond = setup.make_precond(level_exts)
+        stencil, pdot, d, _maskd = _shard_ops(
             problem, px, py, bm, bn, level_exts[0][0], level_exts[0][1],
             dtype, "xla", interpret,
         )
@@ -271,6 +302,128 @@ def build_mg_sharded_solver(
         return result
 
     return jax.jit(solver), args
+
+
+def build_mg_sharded_stepper(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+    kind: str = "mg",
+    config=None,
+    abft: bool = False,
+):
+    """(init_fn, advance_fn, recover_fn) for chunked/resumable
+    mesh-preconditioned solves — the ``parallel.pcg_sharded.
+    build_sharded_stepper`` contract with the V-cycle/Chebyshev in the
+    ``z = M⁻¹r`` slot, which is what lets ``resilience.guard`` chunk,
+    health-check and recover mg-pcg/cheb-pcg mesh solves exactly like
+    the classical stepper (carry layout is shared; only the preconditioner
+    and the per-level operands differ). ``abft=True`` appends the four
+    ABFT shadow scalars and runs the in-loop SDC checks at the same
+    collective cadence (``resilience.abft``).
+
+    ``recover_fn`` is the true-residual restart under the SAME M —
+    z and zr are rebuilt through the preconditioner, so the restarted
+    recurrence still describes M⁻¹A (the guard's parity contract).
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    setup = _MgShardSetup(problem, mesh, dtype, kind, config)
+    px, py, bm, bn = setup.px, setup.py, setup.bm, setup.bn
+    interpret = setup.interpret
+    spec = setup.spec
+    args = setup.args
+    scalar = P()
+    state_specs = (scalar, spec, spec, spec, scalar, scalar, scalar, scalar)
+    if abft:
+        state_specs = state_specs + (scalar,) * 4
+    n_level_args = len(args) - 3
+
+    def init_shard(a_blk, b_blk, rhs_blk, *level_blks):
+        level_exts = setup.extend_levels(a_blk, b_blk, level_blks)
+        precond = setup.make_precond(level_exts)
+        _stencil, pdot, d, _maskd = _shard_ops(
+            problem, px, py, bm, bn, level_exts[0][0], level_exts[0][1],
+            dtype, "xla", interpret,
+        )
+        return _shard_init(
+            problem, px, py, bm, bn, pdot, d, rhs_blk, dtype,
+            precond=precond, abft=abft,
+        )
+
+    def advance_shard(a_blk, b_blk, state, limit, *level_blks):
+        from poisson_ellipse_tpu.resilience.abft import checksum_field
+
+        level_exts = setup.extend_levels(a_blk, b_blk, level_blks)
+        precond = setup.make_precond(level_exts)
+        stencil, pdot, d, maskd = _shard_ops(
+            problem, px, py, bm, bn, level_exts[0][0], level_exts[0][1],
+            dtype, "xla", interpret,
+        )
+        c = checksum_field(stencil, maskd) if abft else None
+        return _shard_advance(
+            problem, stencil, pdot, d, state, dtype, limit=limit,
+            precond=precond, abft=abft, abft_c=c,
+        )
+
+    def recover_shard(a_blk, b_blk, rhs_blk, state, *level_blks):
+        level_exts = setup.extend_levels(a_blk, b_blk, level_blks)
+        precond = setup.make_precond(level_exts)
+        stencil, pdot, _d, _maskd = _shard_ops(
+            problem, px, py, bm, bn, level_exts[0][0], level_exts[0][1],
+            dtype, "xla", interpret,
+        )
+        k, w, _r, p, _zr, diff, _c, _bd = state[:8]
+        r2 = rhs_blk - stencil(w)
+        z2 = precond(r2)
+        zr2 = pdot(z2, r2)
+        out = (
+            k, w, r2, p, zr2, diff,
+            jnp.asarray(False), jnp.asarray(False),
+        )
+        if abft:
+            sums = lax.psum(
+                jnp.stack([jnp.sum(r2), jnp.sum(w), jnp.sum(p)]),
+                (AXIS_X, AXIS_Y),
+            )
+            out = out + (sums[0], sums[1], sums[2], jnp.asarray(False))
+        return out
+
+    level_specs = (spec,) * n_level_args
+    # no donation on any half: operands are re-fed every chunk and the
+    # carry doubles as the guard's rollback point
+    init_mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
+        init_shard,
+        mesh=mesh,
+        in_specs=(spec, spec, spec) + level_specs,
+        out_specs=state_specs,
+    ))
+    advance_mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
+        advance_shard,
+        mesh=mesh,
+        in_specs=(spec, spec, state_specs, scalar) + level_specs,
+        out_specs=state_specs,
+    ))
+    recover_mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
+        recover_shard,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, state_specs) + level_specs,
+        out_specs=state_specs,
+    ))
+
+    def init_fn():
+        return init_mapped(*args[:3], *args[3:])
+
+    def advance_fn(state, limit):
+        return advance_mapped(
+            args[0], args[1], state, jnp.asarray(limit, jnp.int32),
+            *args[3:],
+        )
+
+    def recover_fn(state):
+        return recover_mapped(args[0], args[1], args[2], state, *args[3:])
+
+    return init_fn, advance_fn, recover_fn
 
 
 def solve_mg_sharded(problem: Problem, mesh: Mesh | None = None,

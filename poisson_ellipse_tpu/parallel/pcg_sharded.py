@@ -47,8 +47,10 @@ from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
 def _shard_ops(problem: Problem, px: int, py: int, bm: int, bn: int,
                a_ext, b_ext, dtype, stencil_impl: str = "xla",
                interpret: bool = False):
-    """(stencil, pdot, d) closures for one shard — shared by the
-    whole-solve and chunked-advance paths.
+    """(stencil, pdot, d, maskd) closures for one shard — shared by the
+    whole-solve and chunked-advance paths. ``maskd`` is the shard's
+    interior mask in ``dtype`` (the ABFT checksum field is one stencil
+    application over it).
 
     stencil_impl "pallas" runs the explicit VMEM-tiled stencil kernel
     (``ops.pallas_kernels.apply_a_block_pallas``) on each shard every
@@ -99,12 +101,12 @@ def _shard_ops(problem: Problem, px: int, py: int, bm: int, bn: int,
     def pdot(u, v):
         return lax.psum(jnp.sum(u * v), (AXIS_X, AXIS_Y)) * h1 * h2
 
-    return stencil, pdot, d
+    return stencil, pdot, d, maskd
 
 
 def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
                 pdot, d, rhs_blk, dtype, history: bool = False,
-                precond=None):
+                precond=None, abft: bool = False):
     """The full PCG carry at iteration 0 on one shard — layout matches
     ``solver.pcg.init_state`` (k, w, r, p, zr, diff, converged,
     breakdown), with w/r/p as per-shard blocks and replicated scalars.
@@ -112,7 +114,10 @@ def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
     scattered from psum-reduced scalars, so they stay replicated too.
     ``precond`` swaps the diagonal preconditioner for a per-shard
     ``z = M⁻¹ r`` applier (``parallel.mg_sharded``'s V-cycle/Chebyshev
-    closures — halo ppermutes only, no scalar collectives)."""
+    closures — halo ppermutes only, no scalar collectives).
+    ``abft=True`` appends the four ABFT shadow scalars
+    (S_r, S_w, S_p_pred, sdc — ``resilience.abft``), anchored by one
+    stacked psum at iteration 0 (one-time, off the per-iteration path)."""
     # the zeros literal is device-invariant; mark it varying over the mesh so
     # the while_loop carry type matches the (varying) per-device updates
     w0 = pcast_varying(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y))
@@ -130,13 +135,24 @@ def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
         jnp.asarray(False),
         jnp.asarray(False),
     )
+    if history and abft:
+        raise ValueError("history capture and ABFT extend the same carry "
+                         "tail; request one or the other")
     if history:
         state = state + history_init(problem.max_iterations, dtype)
+    if abft:
+        sums = lax.psum(
+            jnp.stack([jnp.sum(r0), jnp.sum(p0)]), (AXIS_X, AXIS_Y)
+        )
+        state = state + (
+            sums[0], jnp.asarray(0.0, dtype), sums[1], jnp.asarray(False)
+        )
     return state
 
 
 def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
-                   limit=None, history: bool = False, precond=None):
+                   limit=None, history: bool = False, precond=None,
+                   abft: bool = False, abft_c=None):
     """Advance the sharded PCG carry until convergence/breakdown or
     iteration ``limit`` (defaults to max_iterations). Chunking only moves
     the while_loop boundary, not the arithmetic — same contract as
@@ -149,7 +165,15 @@ def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
     the convergence word stays the ONE stacked psum below, the denom
     psum stays the other, and any preconditioner communication is halo
     ppermutes inside ``precond`` itself (jaxpr-pinned in
-    ``tests/test_mg.py``)."""
+    ``tests/test_mg.py``).
+
+    ``abft=True`` runs the in-loop SDC checks of ``resilience.abft``
+    over the 4-scalar-extended carry, with ``abft_c`` the per-shard
+    checksum field ``A·1`` (built OUTSIDE the loop —
+    ``abft.checksum_field``). Every checksum partial is stacked into the
+    SAME convergence psum, so the collective cadence is byte-identical
+    to the plain loop: 1 denom psum + 1 stacked psum per iteration,
+    pinned from the jaxpr in ``tests/test_elastic.py``."""
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     delta = jnp.asarray(problem.delta, dtype)
@@ -164,7 +188,29 @@ def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
 
     def cond(state):
         k, converged, breakdown = state[0], state[6], state[7]
-        return (k < max_iter) & ~converged & ~breakdown
+        go = (k < max_iter) & ~converged & ~breakdown
+        if abft:
+            # a flagged carry stops the loop at once: every further
+            # iteration would compute on (and amplify) the corruption,
+            # and the guard is going to roll the whole chunk back anyway
+            go = go & ~state[_SDC]
+        return go
+
+    if abft and (history or abft_c is None):
+        raise ValueError(
+            "abft needs the checksum field (abft_c) and excludes history "
+            "capture — both extend the carry tail"
+        )
+    if abft:
+        # the shadow-tail layout lives with resilience.abft; every
+        # consumer (this loop, the guard's adapter, the meshguard)
+        # addresses it through the same constants
+        from poisson_ellipse_tpu.resilience.abft import (
+            SDC as _SDC,
+            SP_PRED as _SP,
+            SR as _SR,
+            SW as _SW,
+        )
 
     def body(state):
         k, w, r, p, zr, _diff, _c, _bd = state[:8]
@@ -180,8 +226,24 @@ def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
         # one collective for both scalars (vs 2 of the reference's 3
         # Allreduces; the denominator one above is inherently sequential)
         dw = w_new - w
-        partial_sums = jnp.stack([jnp.sum(z * r_new), jnp.sum(dw * dw)])
-        zr_sum, dw2 = lax.psum(partial_sums, (AXIS_X, AXIS_Y))
+        if abft:
+            # the ABFT partials ride the SAME stacked psum — every term
+            # is a reduction over an array this body already produces or
+            # reads (ap, r⁺, w⁺, p, z; c is the loop-invariant checksum
+            # field), fused by XLA into the passes that materialize them
+            partials = jnp.stack([
+                jnp.sum(z * r_new), jnp.sum(dw * dw),
+                jnp.sum(ap), jnp.sum(abft_c * p), jnp.sum(jnp.abs(ap)),
+                jnp.sum(r_new), jnp.sum(jnp.abs(r_new)),
+                jnp.sum(w_new), jnp.sum(jnp.abs(w_new)),
+                jnp.sum(p), jnp.sum(jnp.abs(p)),
+                jnp.sum(z),
+            ])
+            sums = lax.psum(partials, (AXIS_X, AXIS_Y))
+            zr_sum, dw2 = sums[0], sums[1]
+        else:
+            partial_sums = jnp.stack([jnp.sum(z * r_new), jnp.sum(dw * dw)])
+            zr_sum, dw2 = lax.psum(partial_sums, (AXIS_X, AXIS_Y))
         zr_new = zr_sum * h1 * h2
         diff = jnp.sqrt(dw2 * h1 * h2) if weighted else jnp.sqrt(dw2)
         converged = ~breakdown & (diff < delta)
@@ -202,6 +264,43 @@ def _shard_advance(problem: Problem, stencil, pdot, d, state, dtype,
                 state[8:], k, zr_new, diff,
                 jnp.where(breakdown, 0.0, alpha), beta,
             )
+        if abft:
+            from poisson_ellipse_tpu.resilience.abft import (
+                ABFT_TINY,
+                abft_rtol,
+            )
+
+            S_r, S_w, S_p_pred, sdc = (
+                state[_SR], state[_SW], state[_SP], state[_SDC]
+            )
+            s_ap, s_cp, s_absap = sums[2], sums[3], sums[4]
+            s_r, s_absr = sums[5], sums[6]
+            s_w, s_absw = sums[7], sums[8]
+            s_p, s_absp = sums[9], sums[10]
+            s_z = sums[11]
+            rtol = abft_rtol(dtype)
+            aa = jnp.abs(alpha)
+            # every check written as ~(drift <= tol): a NaN drift must
+            # read as a violation, and NaN <= tol is False in IEEE
+            ok_stencil = jnp.abs(s_ap - s_cp) <= rtol * (s_absap + ABFT_TINY)
+            ok_r = jnp.abs(s_r - (S_r - alpha * s_ap)) <= rtol * (
+                s_absr + aa * s_absap + ABFT_TINY
+            )
+            ok_w = jnp.abs(s_w - (S_w + alpha * s_p)) <= rtol * (
+                s_absw + aa * s_absp + ABFT_TINY
+            )
+            ok_p = jnp.abs(s_p - S_p_pred) <= rtol * (s_absp + ABFT_TINY)
+            ok_pos = zr > 0  # ⟨z, r⟩ is an energy product: > 0 until done
+            fault = ~breakdown & ~(
+                ok_stencil & ok_r & ok_w & ok_p & ok_pos
+            )
+            keep = lambda old, new: jnp.where(breakdown, old, new)
+            out = out + (
+                keep(S_r, s_r),
+                keep(S_w, s_w),
+                keep(S_p_pred, s_z + beta * s_p),
+                sdc | fault,
+            )
         return out
 
     return lax.while_loop(cond, body, state)
@@ -215,7 +314,7 @@ def _local_pcg(problem: Problem, px: int, py: int, bm: int, bn: int,
     (bm+2, bn+2) coefficient blocks, rhs_blk its owned (bm, bn) RHS
     block. With ``history`` the four replicated (cap,) trace buffers
     ride at the end of the returned tuple."""
-    stencil, pdot, d = _shard_ops(
+    stencil, pdot, d, _maskd = _shard_ops(
         problem, px, py, bm, bn, a_ext, b_ext, dtype, stencil_impl, interpret
     )
     state0 = _shard_init(
@@ -394,6 +493,7 @@ def build_sharded_stepper(
     mesh: Mesh | None = None,
     dtype=jnp.float32,
     stencil_impl: str = "xla",
+    abft: bool = False,
 ):
     """(init_fn, advance_fn) for chunked/resumable sharded solves.
 
@@ -412,6 +512,11 @@ def build_sharded_stepper(
     The reference has no distributed checkpointing at all (SURVEY §5) —
     its MPI runs are start-to-finish; this is the subsystem the long
     sharded runs (the only ones long enough to need it) get natively.
+
+    ``abft=True`` extends the carry with the four ABFT shadow scalars
+    (``resilience.abft``) and runs the in-loop SDC checks; the checksum
+    field ``A·1`` is built per dispatch, outside the loop, and the
+    per-iteration collective cadence is byte-identical to abft=False.
     """
     if mesh is None:
         mesh = make_mesh()
@@ -423,26 +528,34 @@ def build_sharded_stepper(
     spec = P(AXIS_X, AXIS_Y)
     scalar = P()
     state_specs = (scalar, spec, spec, spec, scalar, scalar, scalar, scalar)
+    if abft:
+        state_specs = state_specs + (scalar,) * 4
     check_vma = not (stencil_impl == "pallas" and interpret)
 
     def init_shard(a_blk, b_blk, rhs_blk):
         a_ext = halo_extend(a_blk, px, py)
         b_ext = halo_extend(b_blk, px, py)
-        _stencil, pdot, d = _shard_ops(
+        _stencil, pdot, d, _maskd = _shard_ops(
             problem, px, py, bm, bn, a_ext, b_ext, dtype,
             stencil_impl, interpret,
         )
-        return _shard_init(problem, px, py, bm, bn, pdot, d, rhs_blk, dtype)
+        return _shard_init(
+            problem, px, py, bm, bn, pdot, d, rhs_blk, dtype, abft=abft
+        )
 
     def advance_shard(a_blk, b_blk, state, limit):
+        from poisson_ellipse_tpu.resilience.abft import checksum_field
+
         a_ext = halo_extend(a_blk, px, py)
         b_ext = halo_extend(b_blk, px, py)
-        stencil, pdot, d = _shard_ops(
+        stencil, pdot, d, maskd = _shard_ops(
             problem, px, py, bm, bn, a_ext, b_ext, dtype,
             stencil_impl, interpret,
         )
+        c = checksum_field(stencil, maskd) if abft else None
         return _shard_advance(
-            problem, stencil, pdot, d, state, dtype, limit=limit
+            problem, stencil, pdot, d, state, dtype, limit=limit,
+            abft=abft, abft_c=c,
         )
 
     # no donation on either stepper half: a/b are re-fed every chunk, and
@@ -483,6 +596,7 @@ def build_sharded_recover(
     mesh: Mesh | None = None,
     dtype=jnp.float32,
     stencil_impl: str = "xla",
+    abft: bool = False,
 ):
     """Jitted true-residual restart over the sharded carry — the
     recovery primitive ``resilience.guard`` applies to mesh solves.
@@ -493,7 +607,9 @@ def build_sharded_recover(
     residual-replacement form that preserves oracle iteration parity
     (see ``resilience.guard``) — and clears the converged/breakdown
     flags. Same carry layout in and out as ``build_sharded_stepper``, so
-    a recovered carry feeds straight back into ``advance_fn``.
+    a recovered carry feeds straight back into ``advance_fn``. With
+    ``abft`` the four shadow scalars are re-anchored to the rebuilt
+    carry (one stacked psum — recovery is off the hot path).
     """
     if mesh is None:
         mesh = make_mesh()
@@ -505,22 +621,31 @@ def build_sharded_recover(
     spec = P(AXIS_X, AXIS_Y)
     scalar = P()
     state_specs = (scalar, spec, spec, spec, scalar, scalar, scalar, scalar)
+    if abft:
+        state_specs = state_specs + (scalar,) * 4
 
     def recover_shard(a_blk, b_blk, rhs_blk, state):
         a_ext = halo_extend(a_blk, px, py)
         b_ext = halo_extend(b_blk, px, py)
-        stencil, pdot, d = _shard_ops(
+        stencil, pdot, d, _maskd = _shard_ops(
             problem, px, py, bm, bn, a_ext, b_ext, dtype,
             stencil_impl, interpret,
         )
-        k, w, _r, p, _zr, diff, _c, _bd = state
+        k, w, _r, p, _zr, diff, _c, _bd = state[:8]
         r2 = rhs_blk - stencil(w)
         z2 = apply_dinv(r2, d)
         zr2 = pdot(z2, r2)
-        return (
+        out = (
             k, w, r2, p, zr2, diff,
             jnp.asarray(False), jnp.asarray(False),
         )
+        if abft:
+            sums = lax.psum(
+                jnp.stack([jnp.sum(r2), jnp.sum(w), jnp.sum(p)]),
+                (AXIS_X, AXIS_Y),
+            )
+            out = out + (sums[0], sums[1], sums[2], jnp.asarray(False))
+        return out
 
     mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
         recover_shard,
@@ -538,8 +663,9 @@ def build_sharded_recover(
 
 
 def sharded_result_of(problem: Problem, state) -> PCGResult:
-    """View a sharded PCG carry as a PCGResult (crops the shard padding)."""
-    k, w, _r, _p, _zr, diff, converged, breakdown = state
+    """View a sharded PCG carry as a PCGResult (crops the shard padding;
+    any ABFT shadow-scalar tail is ignored)."""
+    k, w, _r, _p, _zr, diff, converged, breakdown = state[:8]
     return PCGResult(
         w=w[: problem.M + 1, : problem.N + 1],
         iters=k,

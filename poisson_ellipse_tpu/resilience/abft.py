@@ -1,0 +1,111 @@
+"""Algorithm-based fault tolerance: SDC detection inside the sharded loop.
+
+Device loss is loud; *silent* data corruption is not — a flipped HBM
+word or a corrupted halo exchange changes the iterate and nothing else,
+and an iterative solver will happily converge its stopping rule on a
+wrong answer (the drifted-recurrence false convergence the guard's
+residual-drift check already exists for). The classical defence for
+sparse iterative solves is algorithm-based: the CG iteration maintains
+algebraic identities that corruption breaks and round-off does not
+(Huang & Abraham's checksum line; Sao & Vuduc's self-stabilizing CG).
+This module is that defence for the sharded engines, at ZERO extra
+collective cost:
+
+- **Stencil checksum (Huang–Abraham).** With ``c = A·1`` (the masked
+  row-sum vector, one stencil application at build time, outside the
+  loop), every iteration satisfies ``Σ(A·p) = Σ(c∘p)`` exactly — a
+  corrupted halo exchange breaks it (the neighbour used a value the
+  owner never sent), a flipped word in the stencil's output breaks it,
+  and f.p. reordering only moves it at round-off scale.
+- **Sum recurrences on the carry.** ``Σr`` obeys
+  ``Σr⁺ = Σr − α·Σ(Ap)``, ``Σw`` obeys ``Σw⁺ = Σw + α·Σp``, and ``Σp``
+  obeys ``Σp⁺ = Σz + β·Σp`` — one scalar shadow per vector, re-anchored
+  to the directly-reduced sum every iteration, so a flip in any carried
+  field between two iterations is caught at the next reduction.
+- **⟨z, r⟩ positivity.** The preconditioned residual inner product is an
+  energy norm — strictly positive for the SPD operator until
+  convergence. A sign-flipped all-reduce result (``psum_corrupt``)
+  violates it immediately.
+
+Every partial these checks need rides THE existing stacked convergence
+psum (``parallel.pcg_sharded._shard_advance`` stacks them into the same
+``lax.psum`` the loop already issues), so the collective cadence stays
+exactly what the engine advertises — 1 stacked psum + 1 denom psum per
+classical iteration, 1 stacked psum per pipelined iteration — pinned
+from the jaxpr via ``obs.static_cost`` in ``tests/test_elastic.py``.
+The partial sums themselves are reductions over arrays the iteration
+already reads or writes (``Ap``, ``r⁺``, ``w⁺``, ``p``, ``z``), fused by
+XLA into the passes that produce them: no extra HBM traffic beyond the
+one loop-invariant checksum field ``c`` (computed per dispatch, outside
+the loop). The measured gate is the ``abft`` bench key: checks-on vs
+checks-off healthy-path overhead ≤ 2% of T_solver with identical
+collective counts.
+
+Detection model (documented, not hoped): a corruption is flagged when
+its magnitude is significant relative to the field's 1-norm
+(``drift > rtol·scale``) — high-exponent/sign flips, NaN/Inf patterns,
+wholesale slab corruption. Low-mantissa flips sit below the round-off
+floor of a global f32 reduction and are *numerically absorbed*: CG
+treats them as an ulp-scale perturbation, and the guard's final
+true-residual gate (``RESIDUAL_DRIFT_TOL``) still validates whatever is
+returned. ``rtol`` is dtype-scaled: pairwise XLA reductions accumulate
+~eps·log₂(n) relative error, and the tolerance sits two-plus orders
+above that floor.
+
+Classification is the point: at a chunk boundary the guard reads the
+accumulated on-device ``sdc`` flag through the same one-word health
+read it already does, and routes SDC *differently* from breakdown —
+**rollback to the last healthy chunk boundary and re-run**, never a
+residual-replacement restart (which would rebuild the recurrence around
+the corrupted iterate and launder the corruption into the answer). A
+transient flip re-runs clean at oracle iteration parity; a corruption
+that re-fires from a clean carry is persistent hardware and raises the
+classified :class:`~poisson_ellipse_tpu.resilience.errors.
+SilentCorruptionError` (exit 6) — never a silently wrong solution.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# indices of the ABFT shadow scalars appended to the classical sharded
+# carry: (…, S_r, S_w, S_p_pred, sdc). This module OWNS the tail layout
+# — pcg_sharded's loop, the guard's sharded adapter and the meshguard
+# all address it through these names (the pipelined carry's differently
+# shaped tail lives with its recurrence: parallel.pipelined_sharded's
+# PIPE_* constants).
+SR, SW, SP_PRED, SDC = 8, 9, 10, 11
+N_ABFT_SCALARS = 4
+
+
+def abft_dummy_tail(dtype):
+    """Placeholder shadow scalars for a converted/restored carry: every
+    conversion is followed by a ``recover`` (or fresh anchor psum) that
+    re-anchors them against the rebuilt arrays — shadow sums are never
+    copied across a layout change."""
+    return (
+        jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype),
+        jnp.asarray(0.0, dtype), jnp.asarray(False),
+    )
+
+# tolerance floor ~ eps·log2(n) for XLA's pairwise reductions, with two-plus
+# orders of margin; keyed by itemsize so bf16 and f16 share a band
+_RTOL_BY_ITEMSIZE = {2: 3e-2, 4: 1e-3, 8: 1e-8}
+
+# guard floor for relative scales: |drift| <= rtol*(scale + ABFT_TINY)
+# keeps an all-zero field (converged, padded) from dividing by nothing
+ABFT_TINY = 1e-30
+
+
+def abft_rtol(dtype) -> float:
+    """The relative drift tolerance for checksum checks at ``dtype``."""
+    return _RTOL_BY_ITEMSIZE[jnp.dtype(dtype).itemsize]
+
+
+def checksum_field(stencil, interior_mask):
+    """``c = A·1`` — the Huang–Abraham row-sum checksum field for one
+    shard, via the engine's OWN masked stencil closure (so the identity
+    ``Σ(A·p) = Σ(c∘p)`` holds for exactly the operator the loop runs,
+    halo exchange included). One stencil application per *dispatch*,
+    outside the iteration loop — never per iteration."""
+    return stencil(interior_mask)

@@ -8,19 +8,27 @@ stack needs the middle layer: every way a guarded solve can fail maps to
 exactly one :class:`SolveError` subclass, each carrying the process exit
 code the harness CLI contracts to return:
 
-  ========  ====================  ===========================================
-  exit      class                 meaning
-  ========  ====================  ===========================================
-  2         DivergedError         recovery ladder exhausted: persistent
-                                  breakdown / NaN poisoning / stagnation
-  3         OutOfMemoryError      RESOURCE_EXHAUSTED with no engine left to
-                                  degrade to
-  4         SolveTimeout          ``--timeout`` deadline passed at a chunk
-                                  boundary (partial trace artifact emitted)
-  5         AdmissionRejected     the serving layer shed the request at
-                                  admission (queue full / projected deadline
-                                  miss); carries ``retry_after_s``
-  ========  ====================  ===========================================
+  ========  ======================  =========================================
+  exit      class                   meaning
+  ========  ======================  =========================================
+  2         DivergedError           recovery ladder exhausted: persistent
+                                    breakdown / NaN poisoning / stagnation
+  3         OutOfMemoryError        RESOURCE_EXHAUSTED with no engine left to
+                                    degrade to
+  4         SolveTimeout            ``--timeout`` deadline passed at a chunk
+                                    boundary (partial trace artifact emitted)
+  5         AdmissionRejected       the serving layer shed the request at
+                                    admission (queue full / projected deadline
+                                    miss); carries ``retry_after_s``
+  6         SilentCorruptionError   the ABFT checksum/invariant layer
+                                    (``resilience.abft``) detected silent
+                                    data corruption that a rollback-and-rerun
+                                    could not clear (a persistent SDC source:
+                                    failing HBM, a sick interconnect lane)
+  7         DeviceLossError         a mesh device was lost and no degraded
+                                    mesh remains to resume on (or the
+                                    degradation budget is exhausted)
+  ========  ======================  =========================================
 
 (exit 0 = converged, 1 = iteration cap reached without convergence — the
 pre-existing harness contract — and the argparse-conventional 2 also
@@ -43,6 +51,8 @@ EXIT_DIVERGED = 2
 EXIT_OOM = 3
 EXIT_TIMEOUT = 4
 EXIT_SHED = 5
+EXIT_SDC = 6
+EXIT_DEVICE_LOSS = 7
 
 
 class SolveError(RuntimeError):
@@ -104,6 +114,34 @@ class AdmissionRejected(SolveError):
         self.retry_after_s = retry_after_s
 
 
+class SilentCorruptionError(SolveError):
+    """The ABFT layer (``resilience.abft``) caught silent data corruption
+    — a checksum/invariant violation in the sharded solve's own algebra
+    (Huang–Abraham stencil checksum, residual/iterate sum recurrences,
+    ⟨r, z⟩ positivity) — and the rollback-and-rerun recovery did not
+    clear it: the corruption re-fired from the same clean carry, which is
+    the signature of a *persistent* SDC source (failing HBM bank, sick
+    interconnect lane), not a transient flip. Raised instead of returning
+    an iterate the corruption may have laundered into; the guard NEVER
+    applies residual replacement to an SDC-flagged carry for exactly that
+    reason."""
+
+    classification = "sdc"
+    exit_code = EXIT_SDC
+
+
+class DeviceLossError(SolveError):
+    """A mesh device was lost (or declared lost by the straggler
+    deadline) and the degraded-mesh ladder has nowhere left to go: no
+    surviving devices, or ``max_degrades`` successive shrinks already
+    spent. Anything short of this is *recovered*, not raised — the mesh
+    guard rebuilds a smaller mesh from the last durable checkpoint and
+    resumes (``resilience.meshguard``)."""
+
+    classification = "device-loss"
+    exit_code = EXIT_DEVICE_LOSS
+
+
 # status phrasings XLA/Mosaic use for memory exhaustion, across runtime
 # versions; matched case-sensitively (they are absl status spellings)
 _OOM_MARKERS = (
@@ -125,6 +163,28 @@ def is_oom_error(exc: BaseException) -> bool:
     return any(marker in text for marker in _OOM_MARKERS)
 
 
+# status phrasings the runtime uses when a device dies under a dispatch;
+# same stance as the OOM markers — the message string is the only
+# machine-readable surface this API exposes. The simulated form
+# (faultinject.SimulatedDeviceLoss) carries the first marker verbatim.
+_DEVICE_LOSS_MARKERS = (
+    "DEVICE_LOST",
+    "device is in an error state",
+    "Device or resource busy",
+    "DATA_LOSS",
+)
+
+
+def is_device_loss_error(exc: BaseException) -> bool:
+    """True when ``exc`` reads as a lost/failed device under a dispatch
+    (real runtime phrasings or the injected
+    ``faultinject.SimulatedDeviceLoss``)."""
+    if isinstance(exc, DeviceLossError):
+        return True
+    text = str(exc)
+    return any(marker in text for marker in _DEVICE_LOSS_MARKERS)
+
+
 def classify_error(exc: BaseException) -> str:
     """The classification tag for an arbitrary exception out of a solve
     dispatch: ``oom`` / ``timeout`` / ``diverged`` (already-classified
@@ -134,4 +194,6 @@ def classify_error(exc: BaseException) -> str:
         return exc.classification
     if is_oom_error(exc):
         return "oom"
+    if is_device_loss_error(exc):
+        return "device-loss"
     return "unknown"

@@ -26,6 +26,29 @@ guard):
 - ``oom``        — raise a ``RESOURCE_EXHAUSTED``-classified error from
                    the solve dispatch at iteration ``k``: what a real
                    device OOM looks like to the host.
+- ``halo_bitflip`` — flip ONE bit of ONE element of a carry field at a
+                   shard-boundary row: the canonical silent-data-
+                   corruption (SDC) shape — a corrupted halo exchange or
+                   a flipped HBM word that no NaN check can see. The
+                   default bit is a high exponent bit (itemsize·8 − 5:
+                   ×2¹²⁸ in f64), the corruption class that matters; low
+                   mantissa flips are numerically absorbed by CG and
+                   validated away by the guard's final true-residual
+                   gate.
+- ``psum_corrupt`` — flip the sign of the carried ⟨z, r⟩ scalar (bit 31
+                   of the psum result, exactly): a corrupted all-reduce.
+                   Detected by the ABFT positivity invariant — (z, r) is
+                   an energy inner product, strictly positive until
+                   convergence.
+- ``device_loss`` — raise a ``DEVICE_LOST``-classified error from the
+                   dispatch at chunk-boundary iteration ``k``: what a
+                   dead mesh device looks like to the host. ``device``
+                   names the lost device id for the degraded-mesh
+                   rebuild (``resilience.meshguard``).
+- ``straggler``  — sleep ``delay_s`` at the chunk boundary before the
+                   dispatch: the slow-device shape. The mesh guard's
+                   per-chunk deadline turns it into a detected
+                   degradation, exactly like a loss.
 
 Separately, :func:`simulated_vmem` shrinks the VMEM capacity the engine
 capacity gates (``fits_resident``/``fits_streamed``) read — so
@@ -47,16 +70,37 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import time
 
 import jax.numpy as jnp
+from jax import lax
 
-FAULT_KINDS = ("nan", "breakdown", "stagnation", "halo", "oom")
+FAULT_KINDS = (
+    "nan", "breakdown", "stagnation", "halo", "oom",
+    "halo_bitflip", "psum_corrupt", "device_loss", "straggler",
+)
+
+# dispatch-level faults: consulted by the driver holding the dispatch
+# (guard / meshguard / scheduler), never applied to a carry
+DISPATCH_KINDS = ("oom", "device_loss", "straggler")
 
 
 class SimulatedResourceExhausted(RuntimeError):
     """The injected stand-in for a device OOM. Its message carries the
     absl ``RESOURCE_EXHAUSTED`` status marker, so it classifies exactly
     as the real thing (``resilience.errors.classify_error``)."""
+
+
+class SimulatedDeviceLoss(RuntimeError):
+    """The injected stand-in for a dead mesh device under a dispatch.
+    The message carries the ``DEVICE_LOST`` marker, so
+    ``resilience.errors.is_device_loss_error`` classifies it exactly as
+    the real runtime failure; ``device`` names the lost device id for
+    the degraded-mesh rebuild."""
+
+    def __init__(self, message: str, device: int | None = None):
+        super().__init__(message)
+        self.device = device
 
 
 @dataclasses.dataclass
@@ -95,6 +139,19 @@ class Fault:
     request_id: str | None = None
     fired: bool = False
     persistent: bool = False
+    # halo_bitflip addressing: shard index out of ``shards`` blocks along
+    # the leading grid axis picks the boundary row; ``bit`` is the flipped
+    # bit (None = itemsize*8 - 5, a high-but-not-top exponent bit — the
+    # SDC that matters without overflowing the very first inner product
+    # to inf; see _flip_bit and the module docstring)
+    shard: int = 0
+    shards: int = 2
+    bit: int | None = None
+    # device_loss / straggler: the device id the simulated failure names
+    # (the meshguard excludes it from the rebuilt mesh) and the injected
+    # straggle duration
+    device: int | None = None
+    delay_s: float = 0.0
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -108,6 +165,14 @@ class Fault:
                 "a fault is addressed by lane OR by request_id, not both "
                 "(the scheduler resolves request_id to a lane at fire time)"
             )
+        if self.kind == "halo_bitflip" and not (
+            0 <= self.shard < self.shards
+        ):
+            raise ValueError(
+                f"shard {self.shard} out of range for {self.shards} shards"
+            )
+        if self.kind == "straggler" and self.delay_s < 0:
+            raise ValueError("delay_s must be >= 0")
 
 
 def inject_nan(at_iter: int, field: str = "r",
@@ -135,6 +200,41 @@ def corrupt_halo(at_iter: int, field: str = "r", rows: int = 1) -> Fault:
 def simulate_oom(at_iter: int = 0) -> Fault:
     """Raise a RESOURCE_EXHAUSTED-classified error at ``at_iter``."""
     return Fault("oom", at_iter=at_iter)
+
+
+def halo_bitflip(at_iter: int, field: str = "r", shard: int = 1,
+                 shards: int = 2, bit: int | None = None,
+                 lane: int | None = None,
+                 persistent: bool = False) -> Fault:
+    """Flip one bit of one element of ``field`` at shard ``shard``'s
+    boundary row — the silent-corruption fault (seed-free deterministic:
+    same carry in, same flipped bit out). The default ``shard=1`` puts
+    the flip on an interior shard-boundary row; shard 0's first row is
+    the Dirichlet ring, where every iterate is exactly 0.0 and a flip
+    is both numerically inert and below the detection model."""
+    return Fault(
+        "halo_bitflip", at_iter=at_iter, field=field, shard=shard,
+        shards=shards, bit=bit, lane=lane, persistent=persistent,
+    )
+
+
+def psum_corrupt(at_iter: int, lane: int | None = None) -> Fault:
+    """Flip the sign of the carried ⟨z, r⟩ — a corrupted all-reduce."""
+    return Fault("psum_corrupt", at_iter=at_iter, lane=lane)
+
+
+def device_loss(chunk: int = 0, device: int = 0) -> Fault:
+    """Raise a DEVICE_LOST-classified error at chunk-boundary iteration
+    ``chunk`` naming ``device`` as the casualty."""
+    return Fault("device_loss", at_iter=chunk, device=device)
+
+
+def straggler(delay_s: float, at_iter: int = 0,
+              device: int | None = None) -> Fault:
+    """Sleep ``delay_s`` at the chunk boundary — the slow-device shape
+    the per-chunk deadline detects."""
+    return Fault("straggler", at_iter=at_iter, delay_s=delay_s,
+                 device=device)
 
 
 class FaultPlan:
@@ -171,8 +271,59 @@ class FaultPlan:
                     "RESOURCE_EXHAUSTED: simulated device OOM "
                     f"(fault injection at iteration {k})"
                 )
+            if fault.kind == "device_loss":
+                raise SimulatedDeviceLoss(
+                    f"DEVICE_LOST: simulated loss of device "
+                    f"{fault.device} (fault injection at iteration {k})",
+                    device=fault.device,
+                )
+            if fault.kind == "straggler":
+                # the slow-device shape: the boundary's dispatch is late
+                # by delay_s, so a per-chunk deadline trips on it
+                time.sleep(fault.delay_s)
+                continue
             state = _corrupt(state, fault, fields, breakdown_index, zr_index)
         return state
+
+    def lost_devices(self) -> list[int]:
+        """Device ids named by fired device_loss/straggler faults — the
+        exclusion list the degraded-mesh rebuild consults."""
+        return [
+            f.device
+            for f in self.faults
+            if f.fired and f.kind in ("device_loss", "straggler")
+            and f.device is not None
+        ]
+
+
+def _flip_bit(value, bit: int | None):
+    """Flip one bit of a floating scalar, deterministically: bitcast to
+    the same-width integer, XOR, bitcast back. ``bit=None`` picks
+    itemsize·8 − 2 — a high exponent bit, the corruption magnitude class
+    the ABFT checksums are specified to catch."""
+    value = jnp.asarray(value)
+    width = value.dtype.itemsize * 8
+    if bit is None:
+        # a high exponent bit — catastrophic (×2^128 in f64, ×2^16 in
+        # f32) but NOT the top one: flipping the exponent MSB overflows
+        # the very first inner product to inf, which reads as nonfinite
+        # rather than exercising the checksum classification
+        bit = width - 5
+    if not 0 <= bit < width:
+        raise ValueError(f"bit {bit} out of range for {value.dtype}")
+    int_dtype = {16: jnp.int16, 32: jnp.int32, 64: jnp.int64}[width]
+    as_int = lax.bitcast_convert_type(value, int_dtype)
+    flipped = as_int ^ jnp.asarray(1 << bit, int_dtype)
+    return lax.bitcast_convert_type(flipped, value.dtype)
+
+
+def _bitflip_site(arr, fault: Fault) -> tuple[int, int]:
+    """(row, col) of the flipped element: shard ``shard``'s first block
+    row (its receive-side halo boundary) at the middle column —
+    deterministic in the fault alone."""
+    rows, cols = arr.shape[-2], arr.shape[-1]
+    row = min((rows // fault.shards) * fault.shard, rows - 1)
+    return row, cols // 2
 
 
 def _corrupt(state, fault: Fault, fields: dict[str, int],
@@ -180,6 +331,21 @@ def _corrupt(state, fault: Fault, fields: dict[str, int],
     state = list(state)
     if fault.lane is not None:
         return _corrupt_lane(state, fault, fields, breakdown_index, zr_index)
+    if fault.kind == "psum_corrupt":
+        zr = state[zr_index]
+        state[zr_index] = -zr  # exactly a sign-bit (bit 31/63) flip
+        return tuple(state)
+    if fault.kind == "halo_bitflip":
+        field = fault.field or "r"
+        if field not in fields:
+            raise ValueError(
+                f"engine carry has no field {field!r} (has {sorted(fields)})"
+            )
+        idx = fields[field]
+        arr = state[idx]
+        row, col = _bitflip_site(arr, fault)
+        state[idx] = arr.at[row, col].set(_flip_bit(arr[row, col], fault.bit))
+        return tuple(state)
     if fault.kind == "breakdown":
         state[breakdown_index] = jnp.asarray(True)
     elif fault.kind == "stagnation":
@@ -218,6 +384,23 @@ def _corrupt_lane(state, fault: Fault, fields: dict[str, int],
     ``fault.lane`` of the named field/flag is touched, so the rest of
     the batch runs clean past the fault (the quarantine contract)."""
     lane = fault.lane
+    if fault.kind == "psum_corrupt":
+        zr = state[zr_index]
+        state[zr_index] = zr.at[lane].set(-zr[lane])
+        return tuple(state)
+    if fault.kind == "halo_bitflip":
+        field = fault.field or "r"
+        if field not in fields:
+            raise ValueError(
+                f"engine carry has no field {field!r} (has {sorted(fields)})"
+            )
+        idx = fields[field]
+        arr = state[idx]
+        row, col = _bitflip_site(arr, fault)
+        state[idx] = arr.at[lane, row, col].set(
+            _flip_bit(arr[lane, row, col], fault.bit)
+        )
+        return tuple(state)
     if fault.kind == "breakdown":
         flags = state[breakdown_index]
         state[breakdown_index] = flags.at[lane].set(True)

@@ -71,9 +71,14 @@ from poisson_ellipse_tpu.obs import trace as obs_trace
 from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.ops.reduction import grid_dot
 from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
+from poisson_ellipse_tpu.resilience.abft import (
+    SDC as ABFT_SDC,
+    abft_dummy_tail as _abft_dummy_tail,
+)
 from poisson_ellipse_tpu.resilience.errors import (
     DivergedError,
     OutOfMemoryError,
+    SilentCorruptionError,
     SolveError,
     SolveTimeout,
     classify_error,
@@ -85,6 +90,11 @@ HEALTH_BREAKDOWN = 1
 HEALTH_NONFINITE = 2
 HEALTH_STAGNATION = 4
 HEALTH_CONVERGED = 8
+# bit 4: the ABFT checksum/invariant layer flagged silent corruption
+# inside the chunk (resilience.abft; sharded engines with abft=True).
+# Routed NOT into the restart ladder but into rollback-and-rerun — a
+# residual-replacement restart would launder the corrupted iterate.
+HEALTH_SDC = 16
 
 _UNHEALTHY = HEALTH_BREAKDOWN | HEALTH_NONFINITE | HEALTH_STAGNATION
 
@@ -138,6 +148,8 @@ def health_name(word: int) -> str:
         names.append("nonfinite")
     if word & HEALTH_STAGNATION:
         names.append("stagnation")
+    if word & HEALTH_SDC:
+        names.append("sdc")
     return "+".join(names) or "healthy"
 
 
@@ -470,12 +482,23 @@ class _PipelinedAdapter:
 class _ShardedAdapter:
     """The mesh-sharded classical carry (``parallel.pcg_sharded``'s
     stepper): same layout as the single-chip classical carry, w/r/p
-    global padded arrays sharded P('x','y'), scalars replicated."""
+    global padded arrays sharded P('x','y'), scalars replicated.
+
+    ``abft=True`` runs the stepper's in-loop SDC checks
+    (``resilience.abft``) — the carry gains the four shadow scalars and
+    the chunk-boundary health word gains the ``HEALTH_SDC`` bit, read
+    through the same single host int. ``precond_kind`` ("mg"/"cheb")
+    swaps in the mesh V-cycle/Chebyshev stepper
+    (``parallel.mg_sharded.build_mg_sharded_stepper``) — chunk/health/
+    recover machinery unchanged, recover rebuilds z/zr under the same M.
+    """
 
     FIELDS = {"w": 1, "r": 2, "p": 3, "zr": 4}
     K, ZR, DIFF, CONV, BD = 0, 4, 5, 6, 7
+    SDC = ABFT_SDC  # the abft-module-owned shadow-tail layout
 
-    def __init__(self, problem: Problem, mesh, dtype, stencil: str = "xla"):
+    def __init__(self, problem: Problem, mesh, dtype, stencil: str = "xla",
+                 abft: bool = False, precond_kind=None):
         from poisson_ellipse_tpu.parallel.pcg_sharded import (
             build_sharded_recover,
             build_sharded_stepper,
@@ -485,14 +508,31 @@ class _ShardedAdapter:
         self.mesh = mesh
         self.dtype = dtype
         self.stencil = stencil
-        self.engine = stencil
-        self._init, self.advance = build_sharded_stepper(
-            problem, mesh, dtype, stencil_impl=stencil
-        )
+        self.abft = abft
+        self.precond_kind = precond_kind
+        if precond_kind is not None:
+            from poisson_ellipse_tpu.parallel.mg_sharded import (
+                build_mg_sharded_stepper,
+            )
+            from poisson_ellipse_tpu.solver.engine import (
+                PRECOND_ENGINE_BY_KIND,
+            )
+
+            self.engine = PRECOND_ENGINE_BY_KIND[precond_kind]
+            self._init, self.advance, self.recover = (
+                build_mg_sharded_stepper(
+                    problem, mesh, dtype, kind=precond_kind, abft=abft
+                )
+            )
+        else:
+            self.engine = stencil
+            self._init, self.advance = build_sharded_stepper(
+                problem, mesh, dtype, stencil_impl=stencil, abft=abft
+            )
+            self.recover = build_sharded_recover(
+                problem, mesh, dtype, stencil_impl=stencil, abft=abft
+            )
         self.advance_fn = self.advance  # already jit-wrapped by the stepper
-        self.recover = build_sharded_recover(
-            problem, mesh, dtype, stencil_impl=stencil
-        )
         import numpy as np
 
         self.rhs_norm = float(
@@ -501,9 +541,12 @@ class _ShardedAdapter:
 
         def health(state, zr_prev, diff_prev, limit):
             k, w, r, p, zr, diff, conv, bd = state[:8]
-            return _health_word(
+            word = _health_word(
                 (w, r, p), zr, diff, k, conv, bd, zr_prev, diff_prev, limit
             )
+            if abft:
+                word = word + state[self.SDC].astype(jnp.int32) * HEALTH_SDC
+            return word
 
         # no donation: the carry doubles as the guard's rollback point
         self.health = jax.jit(health)  # tpulint: disable=TPU004,TPU006
@@ -520,36 +563,220 @@ class _ShardedAdapter:
         return sharded_result_of(self.problem, state)
 
     def escalate(self):
+        if self.precond_kind is not None:
+            return None  # the preconditioner engines fall back first
         if self.stencil != "xla" or jnp.dtype(self.dtype).itemsize >= 8:
             return None
         if not jax.config.jax_enable_x64:
             return None
         adapter = _ShardedAdapter(
             # tpulint: disable=TPU001 — escalation is gated on x64 above
-            self.problem, self.mesh, jnp.float64, stencil="xla"
+            self.problem, self.mesh, jnp.float64, stencil="xla",
+            abft=self.abft,
         )
         # tpulint: disable=TPU001 — escalation is refused without x64
         return adapter, lambda state: _cast_carry(state, jnp.float64)
 
     def fallback(self):
+        if self.precond_kind is not None:
+            # mg/cheb mesh carries pad to their own level geometry —
+            # hand over to the diagonal classical stepper through a
+            # host crop/re-pad (parallel.elastic); the abft tail is
+            # re-anchored by the recover that always follows a convert
+            from poisson_ellipse_tpu.parallel.elastic import reshard_state
+
+            adapter = _ShardedAdapter(
+                self.problem, self.mesh, self.dtype, stencil="xla",
+                abft=self.abft,
+            )
+
+            def convert(state):
+                carry = reshard_state(
+                    self.problem, state[:8], self.mesh, self.dtype
+                )
+                if self.abft:
+                    carry = carry + _abft_dummy_tail(self.dtype)
+                return carry
+
+            return adapter, convert
         if self.stencil == "pallas":
             adapter = _ShardedAdapter(
-                self.problem, self.mesh, self.dtype, stencil="xla"
+                self.problem, self.mesh, self.dtype, stencil="xla",
+                abft=self.abft,
             )
             return adapter, lambda state: state
         return None
 
 
-def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret):
+class _PipelinedShardedAdapter:
+    """The pipelined mesh carry (``parallel.pipelined_sharded``'s
+    stepper): x/r/u/w/z/s/p global padded arrays sharded P('x','y'),
+    γ/diff/flags replicated, plus the lagged ABFT tail when ``abft``.
+    Recovery math runs on the global arrays under plain jit (GSPMD
+    partitions it) — off the hot path by construction."""
+
+    FIELDS = {
+        "x": 1, "r": 2, "u": 3, "w": 4, "z": 5, "s": 6, "p": 7,
+    }
+    K, ZR, DIFF, CONV, BD = 0, 8, 9, 10, 11
+    SDC = 16  # = pipelined_sharded.PIPE_SDC, asserted at __init__
+
+    def __init__(self, problem: Problem, mesh, dtype, abft: bool = False):
+        import numpy as np
+
+        from poisson_ellipse_tpu.parallel.mesh import padded_dims
+        from poisson_ellipse_tpu.parallel.pipelined_sharded import (
+            PIPE_SDC,
+            build_pipelined_sharded_stepper,
+        )
+
+        assert self.SDC == PIPE_SDC  # the recurrence owns its tail layout
+
+        self.problem = problem
+        self.mesh = mesh
+        self.dtype = dtype
+        self.stencil = "xla"
+        self.abft = abft
+        self.engine = "pipelined"
+        self._init, self.advance = build_pipelined_sharded_stepper(
+            problem, mesh, dtype, abft=abft
+        )
+        self.advance_fn = self.advance
+        a_np, b_np, rhs_np = assembly.assemble_numpy(problem)
+        self.rhs_norm = float(np.linalg.norm(rhs_np))
+        g1p, g2p = padded_dims(problem.node_shape, mesh)
+
+        def pad(arr):
+            return jnp.asarray(np.pad(
+                arr, ((0, g1p - arr.shape[0]), (0, g2p - arr.shape[1]))
+            ).astype(assembly.numpy_dtype(dtype)))
+
+        a, b, rhs = pad(a_np), pad(b_np), pad(rhs_np)
+        h1 = jnp.asarray(problem.h1, dtype)
+        h2 = jnp.asarray(problem.h2, dtype)
+        gi = jnp.arange(g1p, dtype=jnp.int32)
+        gj = jnp.arange(g2p, dtype=jnp.int32)
+        interior = assembly.interior_mask(problem, gi, gj)
+        mask = interior.astype(dtype)
+        d = jnp.where(interior, diag_d(a, b, h1, h2), 0.0)
+
+        def recover(state):
+            # the in-loop residual replacement's rebuild on the global
+            # padded arrays (the interior mask reproduces the sharded
+            # stencil's masking): every recurrence-maintained vector
+            # from ground truth, direction p kept
+            k, x = state[0], state[1]
+            p, g, diff = state[7], state[8], state[9]
+            r2 = (rhs - apply_a(x, a, b, h1, h2)) * mask
+            u2 = apply_dinv(r2, d)
+            w2 = apply_a(u2, a, b, h1, h2) * mask
+            s2 = apply_a(p, a, b, h1, h2) * mask
+            z2 = apply_a(apply_dinv(s2, d), a, b, h1, h2) * mask
+            g2 = jnp.where(
+                jnp.isfinite(g) & (g > 0), g, jnp.asarray(1.0, g.dtype)
+            )
+            out = (
+                k, x, r2, u2, w2, z2, s2, p, g2, diff,
+                jnp.asarray(False), jnp.asarray(False),
+            )
+            if abft:
+                # re-anchor the lagged checks to the rebuilt residual
+                # and the kept direction
+                out = out + (
+                    jnp.sum(r2), jnp.sum(jnp.abs(r2)),
+                    jnp.sum(p), jnp.sum(jnp.abs(p)),
+                    jnp.asarray(False),
+                )
+            return out
+
+        self.recover = jax.jit(recover)  # tpulint: disable=TPU006
+
+        def health(state, zr_prev, diff_prev, limit):
+            word = _health_word(
+                state[1:8], state[8], state[9], state[0], state[10],
+                state[11], zr_prev, diff_prev, limit
+            )
+            if abft:
+                word = word + state[self.SDC].astype(jnp.int32) * HEALTH_SDC
+            return word
+
+        # no donation: the carry doubles as the guard's rollback point
+        self.health = jax.jit(health)  # tpulint: disable=TPU004,TPU006
+
+        def to_classical(state):
+            # same direction phase correction as the single-chip
+            # pipelined→classical conversion (see _PipelinedAdapter)
+            k, x = state[0], state[1]
+            p, g, diff = state[7], state[8], state[9]
+            r2 = (rhs - apply_a(x, a, b, h1, h2)) * mask
+            z2 = apply_dinv(r2, d)
+            zr2 = grid_dot(z2, r2, h1, h2)
+            p2 = z2 + (zr2 / g) * p
+            out = (
+                k, x, r2, p2, zr2, diff,
+                jnp.asarray(False), jnp.asarray(False),
+            )
+            if abft:
+                out = out + _abft_dummy_tail(dtype)
+            return out
+
+        self._to_classical = jax.jit(to_classical)  # tpulint: disable=TPU006
+
+    def init(self):
+        return self._init()
+
+    def scalars(self, state):
+        return state[self.ZR], state[self.DIFF]
+
+    def result(self, state) -> PCGResult:
+        from poisson_ellipse_tpu.parallel.pipelined_sharded import (
+            pipelined_sharded_result_of,
+        )
+
+        return pipelined_sharded_result_of(self.problem, state)
+
+    def escalate(self):
+        return None  # the mesh ladder is restart → classical fallback
+
+    def fallback(self):
+        adapter = _ShardedAdapter(
+            self.problem, self.mesh, self.dtype, stencil="xla",
+            abft=self.abft,
+        )
+        return adapter, self._to_classical
+
+
+def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
+                  abft: bool = False):
+    if abft and mesh is None:
+        raise ValueError(
+            "abft covers the sharded engines (the checksum partials ride "
+            "the mesh's stacked convergence psum); single-device solves "
+            "are guarded by the health word + final residual gate alone"
+        )
     if mesh is not None:
         if engine in ("auto", "xla"):
-            return _ShardedAdapter(problem, mesh, dtype, stencil="xla")
+            return _ShardedAdapter(problem, mesh, dtype, stencil="xla",
+                                   abft=abft)
         if engine == "pallas":
-            return _ShardedAdapter(problem, mesh, dtype, stencil="pallas")
+            return _ShardedAdapter(problem, mesh, dtype, stencil="pallas",
+                                   abft=abft)
+        if engine in ("mg-pcg", "cheb-pcg"):
+            from poisson_ellipse_tpu.solver.engine import (
+                PRECOND_KIND_BY_ENGINE,
+            )
+
+            return _ShardedAdapter(
+                problem, mesh, dtype, stencil="xla", abft=abft,
+                precond_kind=PRECOND_KIND_BY_ENGINE[engine],
+            )
+        if engine == "pipelined":
+            return _PipelinedShardedAdapter(problem, mesh, dtype, abft=abft)
         raise ValueError(
-            f"guarded sharded solves run the chunked classical stepper "
-            f"('xla'/'pallas'); got engine={engine!r} — the fused/"
-            "pipelined sharded iterations have no resumable stepper form"
+            f"guarded sharded solves run the chunked steppers "
+            f"('xla'/'pallas'/'pipelined'/'mg-pcg'/'cheb-pcg'); got "
+            f"engine={engine!r} — the fused sharded iteration has no "
+            "resumable stepper form"
         )
     if engine == "xla":
         return _ClassicalAdapter(problem, dtype, stencil="xla")
@@ -597,6 +824,7 @@ def guarded_solve(
     timeout: Optional[float] = None,
     faults: Optional[FaultPlan] = None,
     interpret=None,
+    abft: bool = False,
 ) -> GuardedResult:
     """Solve with failure detection and the recovery ladder (module
     docstring). Loop engines (xla / pallas / pipelined / pipelined-pallas
@@ -613,6 +841,14 @@ def guarded_solve(
     ``faults`` is the deterministic injection plan (tests, ``harness
     inject``); production callers pass none.
 
+    ``abft=True`` (sharded engines only) turns on the in-loop
+    silent-corruption checks of ``resilience.abft``: a flagged chunk is
+    classified apart from breakdown and recovered by rolling back to
+    the last healthy chunk boundary and RE-RUNNING — never a
+    residual-replacement restart, which would launder the corruption
+    into the iterate. Corruption that re-fires from a clean carry
+    raises the classified :class:`SilentCorruptionError` (exit 6).
+
     Raises the classified :class:`SolveError` subclasses on recovery
     exhaustion (``DivergedError``), memory exhaustion with no engine
     left (``OutOfMemoryError``), or deadline (``SolveTimeout``). A
@@ -626,13 +862,20 @@ def guarded_solve(
 
     if mesh is None and engine in ("auto", "resident", "streamed", "xl",
                                    "fused"):
+        if abft:
+            raise ValueError(
+                "abft covers the sharded engines; the whole-solve VMEM "
+                f"engines ({engine!r}) are validated by the final "
+                "health check alone"
+            )
         return _guarded_whole_solve(
             problem, engine, dtype, interpret=interpret, chunk=chunk,
             max_recoveries=max_recoveries, timeout=timeout, t0=t0,
             plan=plan, events=events,
         )
 
-    adapter = _make_adapter(problem, engine, dtype, mesh, interpret)
+    adapter = _make_adapter(problem, engine, dtype, mesh, interpret,
+                            abft=abft)
     return _run_chunked(
         problem, adapter, chunk=chunk, max_recoveries=max_recoveries,
         timeout=timeout, t0=t0, plan=plan, events=events,
@@ -684,6 +927,7 @@ def _run_chunked(problem, adapter, *, chunk, max_recoveries, timeout, t0,
     nrec = 0
     consecutive = 0
     stag_strikes = 0
+    sdc_strikes = 0
     max_iter = problem.max_iterations
 
     while True:
@@ -726,6 +970,36 @@ def _run_chunked(problem, adapter, *, chunk, max_recoveries, timeout, t0,
             stag_strikes = 0
             continue
 
+        if word & HEALTH_SDC and not word & HEALTH_NONFINITE:
+            # Silent corruption, classified apart from breakdown: the
+            # recovery is rollback-to-last-healthy-boundary + RE-RUN —
+            # never residual replacement, which would rebuild the
+            # recurrence around the corrupted iterate and launder the
+            # corruption into the answer. A transient flip re-runs
+            # clean (and to oracle parity — the rollback point is
+            # bit-exact); one that re-fires from a clean carry is a
+            # persistent SDC source and must surface, loudly.
+            nrec += 1
+            if sdc_strikes >= 1 or nrec > max_recoveries:
+                raise SilentCorruptionError(
+                    "silent data corruption re-detected after a clean "
+                    f"rollback-and-rerun at iteration ~{int(prev[adapter.K])}"
+                    " — persistent corruption source; refusing to return "
+                    "an iterate it may have touched",
+                    iters=int(prev[adapter.K]),
+                )
+            _record(
+                events, "sdc-rollback", int(prev[adapter.K]), word,
+                adapter.engine,
+                detail="ABFT checksum/invariant violation; rolling back "
+                "to the last healthy chunk boundary and re-running",
+            )
+            state = prev
+            k = int(prev[adapter.K])
+            sdc_strikes += 1
+            stag_strikes = 0
+            continue
+
         if word & HEALTH_CONVERGED and not word & _UNHEALTHY:
             drift = _residual_drift(adapter, new)
             if drift <= RESIDUAL_DRIFT_TOL:
@@ -743,6 +1017,7 @@ def _run_chunked(problem, adapter, *, chunk, max_recoveries, timeout, t0,
             k = limit
             consecutive = 0
             stag_strikes = 0
+            sdc_strikes = 0
             if k >= max_iter:
                 break
             continue

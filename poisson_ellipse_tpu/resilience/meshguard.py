@@ -1,0 +1,297 @@
+"""Degraded-mesh recovery: survive the hardware the solve runs on.
+
+``resilience.guard`` recovers *numerical* failure on a healthy mesh;
+this module recovers the mesh itself. The reference's MPI stages die
+wholesale when any rank fails (``MPI_Init``/``Finalize`` with no
+recovery surface — ``parallel.multihost``); at pod scale device loss
+and stragglers are routine, so the serving north star needs the ladder
+this module is:
+
+1. **Detect at chunk boundaries.** The dispatched chunk is the unit of
+   failure: a lost device surfaces as a classified dispatch error
+   (``errors.is_device_loss_error`` — real runtime phrasings or the
+   injected ``SimulatedDeviceLoss``), a straggler as a chunk that blows
+   the per-chunk deadline (``chunk_deadline_s`` — the hedge policy: a
+   device too slow IS lost, capacity-wise). ABFT silent-corruption flags
+   (``abft=True``) are read at the same boundary and answered with
+   reload-from-checkpoint + re-run — the durable form of the guard's
+   rollback — before any corrupted carry can be checkpointed.
+2. **Durable state, elastic layout.** Every chunk boundary saves the
+   classical 8-field carry through ``solver.checkpoint`` (orbax commit +
+   integrity manifests + quarantine — the PR 4 machinery, unchanged).
+   The checkpoint fingerprints its mesh SHAPE, and resuming onto a
+   different shape re-shards instead of refusing: crop the dead mesh's
+   padding, re-pad to the survivors' decomposition, lay out, continue
+   (``parallel.elastic``; the reshard parity case is pinned in
+   ``tests/test_checkpoint.py``).
+3. **Shrink and resume.** On detection: emit a ``degrade:mesh`` trace
+   event, rebuild a near-square mesh over the surviving devices
+   (``parallel.elastic.shrink_mesh``), restore the last durable step,
+   and keep solving. ``max_degrades`` successive shrinks (or an empty
+   survivor set) raise the classified
+   :class:`~poisson_ellipse_tpu.resilience.errors.DeviceLossError` —
+   never a hang, never a silent partial result.
+
+Solution parity is the contract: a 2×2 solve killed mid-flight and
+finished on 1×2 reaches the same l2-vs-analytic error as an
+uninterrupted run (decomposition changes only psum reduction grouping —
+ulp-scale — plus at most one chunk of replayed iterations), pinned in
+``tests/test_elastic.py``.
+
+The serving layer composes differently — a scheduler's in-flight batch
+carry is disposable, so ``serve.scheduler`` answers device loss by
+re-entering every in-flight request through the journal/retry ladder
+(chaos-tested in ``serve.chaos`` mesh-kill drills) — but both rest on
+the same detection and classification here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.resilience.abft import (
+    SDC as _SDC,
+    abft_dummy_tail,
+)
+from poisson_ellipse_tpu.resilience.errors import (
+    DeviceLossError,
+    SilentCorruptionError,
+    classify_error,
+)
+from poisson_ellipse_tpu.resilience.faultinject import FaultPlan
+from poisson_ellipse_tpu.solver.pcg import PCGResult
+
+# classical sharded carry addressing (the meshguard drives the classical
+# stepper; the guard's engine zoo handles the rest; the ABFT shadow tail
+# is addressed through resilience.abft's layout constants)
+_FIELDS = {"w": 1, "r": 2, "p": 3, "zr": 4}
+_BD, _ZR = 7, 4
+
+DEFAULT_CHUNK = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshEvent:
+    """One mesh-level action: what was detected and what the guard did."""
+
+    kind: str       # degrade:mesh / sdc-rollback
+    at_iter: int
+    cause: str      # device-loss / straggler-deadline / abft
+    mesh_before: tuple[int, int]
+    mesh_after: tuple[int, int]
+
+
+@dataclasses.dataclass
+class ElasticResult:
+    """A mesh-guarded solve's outcome: the PCGResult, the degradation
+    story (empty ``events`` = the original mesh survived), and the mesh
+    shape that actually finished the solve."""
+
+    result: PCGResult
+    events: tuple
+    mesh_shape: tuple[int, int]
+    degrades: int
+
+
+def _mesh_shape(mesh) -> tuple[int, int]:
+    from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y
+
+    return (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
+
+
+def elastic_solve(
+    problem: Problem,
+    mesh=None,
+    dtype=jnp.float32,
+    *,
+    directory: str,
+    chunk: int = DEFAULT_CHUNK,
+    abft: bool = False,
+    chunk_deadline_s: Optional[float] = None,
+    faults: Optional[FaultPlan] = None,
+    max_degrades: int = 2,
+) -> ElasticResult:
+    """Solve on ``mesh`` with device-loss/straggler detection and
+    degraded-mesh recovery (module docstring). ``directory`` holds the
+    durable checkpoints every chunk boundary writes — it IS the recovery
+    point, so give it a filesystem that survives the devices.
+
+    ``chunk_deadline_s`` arms straggler detection: a chunk whose
+    dispatch (fenced) overruns it degrades the mesh exactly like a
+    loss, excluding the straggling device when the fault plan names one
+    (real deployments name it from runtime telemetry) and the
+    highest-index device otherwise — the hedge policy.
+    """
+    from poisson_ellipse_tpu.parallel.elastic import shrink_mesh
+    from poisson_ellipse_tpu.parallel.mesh import make_mesh
+    from poisson_ellipse_tpu.parallel.pcg_sharded import (
+        build_sharded_recover,
+        build_sharded_stepper,
+        sharded_result_of,
+    )
+    from poisson_ellipse_tpu.solver.checkpoint import CheckpointingSolver
+
+    if mesh is None:
+        mesh = make_mesh()
+    plan = faults if faults is not None else FaultPlan()
+    events: list[MeshEvent] = []
+    degrades = 0
+    sdc_strikes = 0
+    max_iter = problem.max_iterations
+
+    while True:  # one pass per mesh incarnation
+        shape = _mesh_shape(mesh)
+        store = CheckpointingSolver(
+            problem, directory, chunk=chunk, dtype=dtype, mesh=mesh
+        )
+        try:
+            # one stepper build per MESH INCARNATION is the degraded-mesh
+            # ladder itself (bounded by max_degrades), not a hot-loop
+            # retrace — the chunk loop below reuses these compiled fns
+            init_fn, advance_fn = build_sharded_stepper(
+                problem, mesh, dtype, abft=abft  # tpulint: disable=TPU013
+            )
+            restored = store.restore_latest()
+            if restored is None:
+                state = init_fn()
+            elif abft:
+                # the restored 8-field carry needs its shadow scalars
+                # re-anchored against THIS mesh's reductions: the
+                # recover primitive rebuilds r from ground truth and
+                # anchors in one off-hot-path dispatch
+                # (per-incarnation, like the stepper above)
+                recover_fn = build_sharded_recover(
+                    problem, mesh, dtype, abft=True  # tpulint: disable=TPU013
+                )
+                state = recover_fn(
+                    tuple(restored) + abft_dummy_tail(dtype)
+                )
+            else:
+                state = tuple(restored)
+
+            lost: list[int] = []
+            cause = None
+            # the first chunk on a (re)built mesh pays trace+compile:
+            # the straggler deadline judges steady-state dispatches only
+            compile_chunk = True
+            while True:  # chunk loop on this mesh
+                k = int(state[0])
+                if bool(state[6]) or bool(state[7]) or k >= max_iter:
+                    result = sharded_result_of(problem, state[:8])
+                    return ElasticResult(
+                        result=result,
+                        events=tuple(events),
+                        mesh_shape=shape,
+                        degrades=degrades,
+                    )
+                stop = plan.next_stop(k - 1)
+                limit = min(k + chunk, max_iter)
+                if stop is not None and k < stop:
+                    limit = min(limit, stop)
+                t0 = time.monotonic()
+                # dispatch-level faults (device_loss raises, straggler
+                # sleeps) and carry-level SDC faults fire here, exactly
+                # at the boundary — the guard's injection contract
+                run_state = plan.apply(
+                    k, state, _FIELDS, _BD, _ZR
+                ) if plan else state
+                new = advance_fn(run_state, limit)
+                jax.block_until_ready(new)  # the deadline needs a fence
+                elapsed = time.monotonic() - t0
+                was_compile_chunk, compile_chunk = compile_chunk, False
+                if (
+                    chunk_deadline_s is not None
+                    and not was_compile_chunk
+                    and elapsed > chunk_deadline_s
+                ):
+                    # only devices still IN this mesh count as an
+                    # attribution — earlier degrades already removed
+                    # theirs, and excluding a gone device would burn a
+                    # degrade on an identical mesh
+                    present = {d.id for d in mesh.devices.flat}
+                    lost = [
+                        d for d in plan.lost_devices() if d in present
+                    ] or [max(present)]
+                    cause = "straggler-deadline"
+                    break
+                if abft and bool(new[_SDC]):
+                    # silent corruption flagged: the durable checkpoint
+                    # is the rollback point — reload it and re-run the
+                    # chunk; NEVER checkpoint the flagged carry. A
+                    # re-fire from the clean reload is persistent
+                    # hardware: classified error.
+                    sdc_strikes += 1
+                    if sdc_strikes > 1:
+                        raise SilentCorruptionError(
+                            "silent corruption re-detected after a "
+                            f"clean reload at iteration ~{k} — "
+                            "persistent SDC source under this mesh",
+                            iters=k,
+                        )
+                    obs_trace.event(
+                        "recovery:sdc-rollback", iter=k, engine="xla",
+                        detail="meshguard: reload last checkpoint + rerun",
+                    )
+                    events.append(MeshEvent(
+                        "sdc-rollback", k, "abft", shape, shape
+                    ))
+                    reloaded = store.restore_latest()
+                    if reloaded is None:
+                        state = init_fn()
+                    else:
+                        # a rare recovery action, bounded by the
+                        # sdc_strikes budget above, not a hot retrace
+                        recover_fn = build_sharded_recover(
+                            problem,
+                            mesh,  # tpulint: disable=TPU013
+                            dtype,
+                            abft=True,
+                        )
+                        state = recover_fn(
+                            tuple(reloaded) + abft_dummy_tail(dtype)
+                        )
+                    continue
+                sdc_strikes = 0
+                state = new
+                store.save(state)
+        except Exception as e:  # noqa: BLE001 — classified; unknowns re-raised
+            if classify_error(e) != "device-loss":
+                raise
+            present = {d.id for d in mesh.devices.flat}
+            named = getattr(e, "device", None)
+            lost = [named] if named in present else [
+                d for d in plan.lost_devices() if d in present
+            ]
+            if not lost:
+                lost = [max(present)]
+            cause = "device-loss"
+        finally:
+            store.close()
+
+        # ---- degrade: shrink the mesh and resume from the checkpoint ----
+        degrades += 1
+        if degrades > max_degrades:
+            raise DeviceLossError(
+                f"mesh degraded {degrades - 1} time(s) already and "
+                f"{cause} struck again — degradation budget exhausted",
+                iters=None,
+            )
+        new_mesh = shrink_mesh(mesh, lost)
+        obs_trace.event(
+            "degrade:mesh",
+            cause=cause,
+            lost_devices=sorted(lost),
+            from_mesh=list(shape),
+            to_mesh=list(_mesh_shape(new_mesh)),
+        )
+        events.append(MeshEvent(
+            "degrade:mesh", 0, cause, shape, _mesh_shape(new_mesh)
+        ))
+        mesh = new_mesh

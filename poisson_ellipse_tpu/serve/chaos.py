@@ -51,6 +51,7 @@ class ChaosReport:
     killed: bool
     faults_fired: int
     wall_s: float
+    mesh_killed: bool = False  # a device-loss drill ran mid-stream
 
     @property
     def ok(self) -> bool:
@@ -80,6 +81,7 @@ def run_chaos(
     oom_request: Optional[int] = 5,
     deadline_s: Optional[float] = None,
     max_retries: int = 2,
+    mesh_kill_request: Optional[int] = None,
 ) -> ChaosReport:
     """Drive one seeded chaos stream; see the module docstring.
 
@@ -91,6 +93,14 @@ def run_chaos(
     request-addressed NaN-poisoned lane and a fake RESOURCE_EXHAUSTED
     (None disables either). Requires ``journal_path`` when a kill is
     scheduled (the replay is the point).
+
+    ``mesh_kill_request`` arms the DEVICE-kill drill (the ``harness
+    chaos --mesh`` flag): when that request is in flight, a simulated
+    device loss takes out every live batch carry at once — every
+    in-flight request re-enters through the journal/retry ladder
+    (``Scheduler._degrade_mesh``) — and the zero-lost/zero-double/
+    all-classified invariants are asserted across a device kill, not
+    just a process kill.
     """
     if n_requests < 1:
         raise ValueError("need at least one request")
@@ -113,6 +123,11 @@ def run_chaos(
     if oom_request is not None and oom_request < n_requests:
         faults.append(Fault(
             "oom", at_iter=2, request_id=_chaos_id(oom_request),
+        ))
+    if mesh_kill_request is not None and mesh_kill_request < n_requests:
+        faults.append(Fault(
+            "device_loss", at_iter=1, device=0,
+            request_id=_chaos_id(mesh_kill_request),
         ))
 
     def make_scheduler():
@@ -192,6 +207,9 @@ def run_chaos(
         killed=kill,
         faults_fired=sum(1 for f in faults if f.fired),
         wall_s=time.monotonic() - t0,
+        mesh_killed=any(
+            f.kind == "device_loss" and f.fired for f in faults
+        ),
     )
     obs_trace.event("serve:chaos-report", **report.json_dict())
     return report

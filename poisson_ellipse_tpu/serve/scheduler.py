@@ -397,10 +397,10 @@ class Scheduler:
         # boundary dispatches from a full line, not a replay-starved one
         self._admit_replay_wave()
         for ctx in list(self._ctxs.values()):
-            if not ctx.active:
+            if not ctx.active or ctx not in self._ctxs.values():
                 continue
             self._apply_faults(ctx)
-            if not ctx.active:
+            if not ctx.active or ctx not in self._ctxs.values():
                 continue
             k = int(ctx.state[0])
             # the chunk stops early at the nearest per-request iteration
@@ -414,10 +414,20 @@ class Scheduler:
                         slot.base_k + slot.req.problem.max_iterations,
                     )
             limit = jnp.asarray(max(limit_val, k + 1), jnp.int32)
-            ctx.state = ctx.fn(
-                ctx.a3, ctx.b3, ctx.mask, ctx.h1, ctx.h2, ctx.delta,
-                ctx.state, limit,
-            )
+            try:
+                ctx.state = ctx.fn(
+                    ctx.a3, ctx.b3, ctx.mask, ctx.h1, ctx.h2, ctx.delta,
+                    ctx.state, limit,
+                )
+            except Exception as e:  # noqa: BLE001 — classified; unknowns re-raised
+                from poisson_ellipse_tpu.resilience.errors import (
+                    classify_error,
+                )
+
+                if classify_error(e) != "device-loss":
+                    raise
+                self._degrade_mesh("device-loss", getattr(e, "device", None))
+                continue
             self._boundary(ctx)
         return bool(
             len(self.queue) or self._replay_backlog
@@ -762,6 +772,48 @@ class Scheduler:
         )
         self._record_terminal(res)
 
+    # -- mesh degradation ----------------------------------------------------
+
+    def _degrade_mesh(self, cause: str, device: int | None) -> None:
+        """A device under the batch died: every live batch carry died
+        with it (the mesh's arrays are unrecoverable), but no REQUEST
+        does — each in-flight request re-enters through the same
+        journal-backed retry ladder a lane fault uses, so the chaos
+        invariants (zero lost / zero double) hold across a device kill
+        exactly as they do across a process kill. A sharded scheduler
+        also shrinks its mesh (``parallel.elastic``) so rebuilt batch
+        contexts land on the survivors; shapes are compile keys, so the
+        rebuilds warm naturally."""
+        in_flight = [
+            slot
+            for ctx in self._ctxs.values()
+            for slot in ctx.slots
+            if slot is not None
+        ]
+        obs_trace.event(
+            "degrade:mesh",
+            cause=cause,
+            lost_devices=[device] if device is not None else [],
+            in_flight=len(in_flight),
+        )
+        obs_metrics.counter("mesh_degrade_total").inc()
+        # the carries are gone: drop every batch context; _ctx_for
+        # rebuilds on demand (on the shrunk mesh, when sharded)
+        self._ctxs.clear()
+        if self.mesh is not None and device is not None:
+            from poisson_ellipse_tpu.parallel.elastic import shrink_mesh
+            from poisson_ellipse_tpu.resilience.errors import (
+                DeviceLossError,
+            )
+
+            try:
+                self.mesh = shrink_mesh(self.mesh, [device])
+            except DeviceLossError:
+                # no mesh left: the single-device path still serves
+                self.mesh = None
+        for slot in in_flight:
+            self._retry_or_fallback(slot, cause)
+
     # -- fault injection -----------------------------------------------------
 
     def _slot_of(self, request_id: str):
@@ -807,6 +859,12 @@ class Scheduler:
                 self._park_lane(ctx, slot.lane)
                 self._retry_or_fallback(slot, "oom")
                 continue
+            if fault.kind == "device_loss":
+                # a whole device under the batch: every in-flight
+                # request (this context's and the others') re-enters;
+                # the addressed request only picks WHEN the kill lands
+                self._degrade_mesh("device-loss", fault.device)
+                return
             lane_fault = Fault(
                 fault.kind, at_iter=fault.at_iter, field=fault.field,
                 rows=fault.rows, lane=slot.lane,

@@ -312,10 +312,20 @@ class CheckpointingSolver:
             step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
         )["meta"]
         if meta != self._fp:
-            raise CheckpointMismatchError(
-                "checkpoint was written by a different problem/dtype: "
-                f"saved {meta}, current {self._fp}"
-            )
+            # A mesh-shape-only mismatch is the ELASTIC resume: the
+            # carry's arithmetic is decomposition-independent (padding is
+            # inert, psum grouping is an ulp-scale reorder), so a
+            # checkpoint written on a mesh that no longer exists — the
+            # degraded-mesh recovery's defining situation — re-shards
+            # instead of refusing. Everything else (grid, dtype, stencil)
+            # changes the *math* and still refuses loudly.
+            drop = lambda fp: {k: v for k, v in fp.items() if k != "mesh"}
+            if drop(meta) != drop(self._fp):
+                raise CheckpointMismatchError(
+                    "checkpoint was written by a different problem/dtype: "
+                    f"saved {meta}, current {self._fp}"
+                )
+            return self._restore_resharded(step, meta)
         # the freshly initialised carry is the restore template: it carries
         # the exact dtypes, shapes and (for sharded runs) shardings the
         # arrays must come back with
@@ -328,6 +338,77 @@ class CheckpointingSolver:
             ),
         )
         return _tree_to_state(restored["state"])
+
+    def _restore_resharded(self, step: int, meta: dict):
+        """Restore a step written under a DIFFERENT mesh shape: pull the
+        arrays to host numpy against a template shaped by the saved
+        fingerprint (the dead mesh's padded dims), crop the old shard
+        padding, and re-lay the carry out over the current mesh (or the
+        single chip). The save-on-2×2/resume-on-1×2 parity case in
+        ``tests/test_checkpoint.py`` pins this path."""
+        import orbax.checkpoint as ocp
+
+        from poisson_ellipse_tpu.parallel.mesh import padded_dims_of
+
+        old_px, old_py = meta["mesh"]
+        g1p, g2p = padded_dims_of(self.problem.node_shape, old_px, old_py)
+        np_dtype = assembly.numpy_dtype(self.dtype)
+        template = {
+            "k": np.zeros((), np.int32),
+            "w": np.zeros((g1p, g2p), np_dtype),
+            "r": np.zeros((g1p, g2p), np_dtype),
+            "p": np.zeros((g1p, g2p), np_dtype),
+            "zr": np.zeros((), np_dtype),
+            "diff": np.zeros((), np_dtype),
+            "converged": np.zeros((), bool),
+            "breakdown": np.zeros((), bool),
+        }
+        restored = self._manager.restore(
+            step,
+            args=ocp.args.Composite(state=ocp.args.StandardRestore(template)),
+        )
+        host = _tree_to_state(
+            {k: np.asarray(v) for k, v in restored["state"].items()}
+        )
+        obs_trace.event(
+            "degrade:checkpoint-reshard",
+            step=step,
+            from_mesh=[old_px, old_py],
+            to_mesh=self._fp["mesh"],
+        )
+        if self.mesh is not None:
+            from poisson_ellipse_tpu.parallel.elastic import reshard_state
+
+            return reshard_state(
+                self.problem, host, self.mesh, self.dtype
+            )
+        g1, g2 = self.problem.node_shape
+        return tuple(
+            jnp.asarray(np.asarray(x)[:g1, :g2])
+            if getattr(x, "ndim", 0) == 2 else jnp.asarray(x)
+            for x in host
+        )
+
+    # -- the meshguard surface ----------------------------------------------
+    # (public wrappers so resilience.meshguard can drive chunks itself —
+    # per-chunk deadlines, fault consults — while this class keeps sole
+    # ownership of durability: save cadence, manifests, quarantine)
+
+    def initial_state(self):
+        """A fresh iteration-0 carry on this solver's mesh/stepper."""
+        return self._init()
+
+    def save(self, state) -> None:
+        """Persist the classical 8-field prefix of ``state`` (an ABFT or
+        history tail is never checkpointed — shadow scalars must be
+        re-anchored against whatever mesh the carry wakes up on)."""
+        self._save(tuple(state[:8]))
+
+    def restore_latest(self):
+        """The newest valid step's carry re-laid-out for THIS solver's
+        mesh (quarantining damage, re-sharding across mesh shapes), or
+        None when nothing survives."""
+        return self._restore_latest_valid()
 
     # -- driving ------------------------------------------------------------
 

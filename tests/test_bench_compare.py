@@ -118,6 +118,50 @@ def test_throughput_drop_is_a_regression():
     ]
 
 
+def _abft_row(**overrides):
+    row = {
+        "available": True, "grid": [800, 1200], "mesh": [1, 2],
+        "t_off_s": 1.0, "t_on_s": 1.01, "overhead_pct": 1.0,
+        "gate_pct": 2.0, "iters_off": 99, "iters_on": 99,
+        "psum_per_iter": 2, "ppermute_per_iter": 4,
+        "collectives_identical": True, "ok": True,
+    }
+    row.update(overrides)
+    return row
+
+
+def test_abft_overhead_creep_is_a_regression():
+    old = make_round(abft=_abft_row())
+    new = make_round(
+        abft=_abft_row(overhead_pct=1.0 + TOL["abft-pp"] * 1.5)
+    )
+    assert regressions_between(old, new) == [("abft_overhead_pct", "abft")]
+    # within the percentage-point band: silent
+    new = make_round(
+        abft=_abft_row(overhead_pct=1.0 + TOL["abft-pp"] * 0.5)
+    )
+    assert regressions_between(old, new) == []
+
+
+def test_abft_broken_cadence_pin_is_a_regression():
+    old = make_round(abft=_abft_row())
+    new = make_round(abft=_abft_row(collectives_identical=False))
+    assert regressions_between(old, new) == [("abft_collectives", "abft")]
+
+
+def test_abft_only_in_one_round_is_noted_not_failed():
+    old = make_round()  # pre-abft artifact
+    new = make_round(abft=_abft_row())
+    regs, notes = bc.compare(old, new, TOL)
+    assert regs == []
+    assert any("abft" in n for n in notes)
+    # an unavailable row (single-device bench box) skips the same way
+    regs, notes = bc.compare(
+        make_round(abft={"available": False}), new, TOL
+    )
+    assert regs == []
+
+
 def _precond_rows():
     return [
         {"grid": [100, 200], "engine": "mg-pcg", "iters": 30,

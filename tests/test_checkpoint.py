@@ -303,24 +303,71 @@ def test_sharded_checkpoint_restores_shardings(tmp_path):
     assert int(restored[0]) == 6
 
 
-def test_mismatched_mesh_is_refused(tmp_path):
+def _mesh_of(n: int, px: int, py: int):
     import jax
 
     from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y
 
+    return jax.sharding.Mesh(
+        np.asarray(jax.devices()[:n]).reshape(px, py), (AXIS_X, AXIS_Y)
+    )
+
+
+def test_save_on_2x2_resume_on_1x2_reshards_to_parity(tmp_path):
+    """The elastic resume: a checkpoint written on a mesh that no longer
+    exists (degraded-mesh recovery's defining situation) re-shards onto
+    the survivors instead of refusing — save on 2×2, kill, resume on
+    1×2, and converge at the uninterrupted run's count and solution
+    (decomposition changes only psum reduction grouping)."""
+    problem = Problem(M=40, N=40)
+    directory = str(tmp_path / "ck")
+    big = _mesh_of(4, 2, 2)
+    small = _mesh_of(2, 1, 2)
+
+    from poisson_ellipse_tpu.parallel.pcg_sharded import solve_sharded
+
+    straight = solve_sharded(problem, small, dtype=jnp.float64)
+
+    with CheckpointingSolver(
+        problem, directory, chunk=8, dtype=jnp.float64, mesh=big
+    ) as s1:
+        state = s1._advance(s1._init(), jnp.asarray(16, jnp.int32))
+        s1._save(state)
+        assert s1.latest_step() == 16
+
+    with CheckpointingSolver(
+        problem, directory, chunk=8, dtype=jnp.float64, mesh=small
+    ) as s2:
+        res = s2.run(resume=True)
+    assert bool(res.converged)
+    assert int(res.iters) == int(straight.iters) == 50
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(straight.w), rtol=1e-11, atol=1e-14
+    )
+
+
+def test_sharded_checkpoint_resumes_on_single_chip(tmp_path):
+    """The degenerate reshard: a sharded checkpoint wakes up with no
+    mesh at all and finishes single-chip."""
     problem = Problem(M=20, N=20)
     directory = str(tmp_path / "ck")
-    solve_with_checkpoints(
-        problem, directory, chunk=6, dtype=jnp.float64, mesh=_full_mesh()
+    with CheckpointingSolver(
+        problem, directory, chunk=6, dtype=jnp.float64, mesh=_mesh_of(4, 2, 2)
+    ) as s1:
+        state = s1._advance(s1._init(), jnp.asarray(6, jnp.int32))
+        s1._save(state)
+
+    a, b, rhs = assembly.assemble(problem, jnp.float64)
+    straight = jax.jit(lambda a, b, rhs: pcg(problem, a, b, rhs))(a, b, rhs)
+    with CheckpointingSolver(
+        problem, directory, chunk=6, dtype=jnp.float64
+    ) as s2:
+        res = s2.run(resume=True)
+    assert bool(res.converged)
+    assert int(res.iters) == int(straight.iters)
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(straight.w), rtol=1e-11, atol=1e-14
     )
-    # a 2x2 sub-mesh changes shard padding and psum grouping -> refused
-    sub = jax.sharding.Mesh(
-        np.asarray(jax.devices()[:4]).reshape(2, 2), (AXIS_X, AXIS_Y)
-    )
-    with pytest.raises(ValueError, match="different problem"):
-        solve_with_checkpoints(
-            problem, directory, chunk=6, dtype=jnp.float64, mesh=sub
-        )
 
 
 def test_mismatched_stencil_is_refused(tmp_path):

@@ -27,9 +27,9 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_thirteen_rules():
+def test_registry_has_all_fourteen_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
-        "TPU010", "TPU011", "TPU012", "TPU013",
+        "TPU010", "TPU011", "TPU012", "TPU013", "TPU014",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -1383,6 +1383,125 @@ def test_tpu013_negative_loop_invariant_factory_call_and_jax_helpers():
                 pltpu.make_async_copy(ref, out, i).start()
     """
     assert codes_of(src) == []
+
+
+# -- TPU014: retry loops without backoff or cap -----------------------------
+
+
+def test_tpu014_positive_hot_spin_retry():
+    src = """
+        def serve_forever(dispatch):
+            while True:
+                try:
+                    return dispatch()
+                except RuntimeError:
+                    continue
+    """
+    assert codes_of(src) == ["TPU014"]
+
+
+def test_tpu014_positive_swallow_and_fall_through():
+    # no explicit continue: falling off the handler re-enters the loop
+    # just the same
+    src = """
+        def poll(fetch, log):
+            while True:
+                try:
+                    item = fetch()
+                    handle(item)
+                except ConnectionError as e:
+                    log(e)
+    """
+    assert codes_of(src) == ["TPU014"]
+
+
+def test_tpu014_negative_backoff_paced_retry():
+    src = """
+        import time
+
+        def serve_forever(dispatch):
+            while True:
+                try:
+                    return dispatch()
+                except RuntimeError:
+                    time.sleep(0.1)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu014_negative_attempt_capped_retry():
+    src = """
+        def bounded(dispatch, budget):
+            attempt = 0
+            while True:
+                try:
+                    return dispatch()
+                except RuntimeError:
+                    attempt += 1
+                if attempt > budget:
+                    raise RuntimeError("budget exhausted")
+    """
+    assert codes_of(src) == []
+    # the inverted spelling caps through the else-arm — same bound
+    inverted = """
+        def bounded(dispatch, budget):
+            attempt = 0
+            while True:
+                try:
+                    return dispatch()
+                except RuntimeError:
+                    attempt += 1
+                if attempt <= budget:
+                    continue
+                else:
+                    raise RuntimeError("budget exhausted")
+    """
+    assert codes_of(inverted) == []
+
+
+def test_tpu014_negative_conditioned_loop_and_reraising_handler():
+    # a tested loop condition is itself a bound; a handler that
+    # re-raises is not a retry
+    src = """
+        def drain(queue):
+            while queue:
+                try:
+                    queue.pop()
+                except IndexError:
+                    continue
+
+        def loud(dispatch):
+            while True:
+                try:
+                    return dispatch()
+                except RuntimeError:
+                    raise
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu014_backoff_fns_configurable_and_suppression():
+    src = """
+        def custom(dispatch, pace):
+            while True:
+                try:
+                    return dispatch()
+                except RuntimeError:
+                    pace()
+    """
+    # the custom pacer is not in the default patterns -> fires; naming
+    # it via the knob silences the loop
+    assert codes_of(src) == ["TPU014"]
+    assert codes_of(src, retry_backoff_fns=("pace",)) == []
+    suppressed = """
+        def drain_worklist(steps):
+            while True:
+                try:
+                    return steps.pop()
+                except KeyError:  # tpulint: disable=TPU014 — pop consumes the worklist
+                    continue
+    """
+    assert codes_of(suppressed) == []
 
 
 def test_suppression_is_per_code_not_blanket():

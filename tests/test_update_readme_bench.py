@@ -221,6 +221,39 @@ def test_recovery_field_absent_or_failed_is_supported(workspace):
     assert "Resilience drill" not in readme.read_text()
 
 
+def test_abft_field_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        abft={
+            "available": True, "grid": [800, 1200], "mesh": [1, 2],
+            "t_off_s": 1.0, "t_on_s": 1.012, "overhead_pct": 1.2,
+            "gate_pct": 2.0, "iters_off": 99, "iters_on": 99,
+            "psum_per_iter": 2, "ppermute_per_iter": 4,
+            "collectives_identical": True, "ok": True,
+        }
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "ABFT silent-corruption checks" in text
+    assert "+1.20%" in text
+    assert "collective counts identical on/off" in text
+    assert "2 psum/iteration" in text
+
+
+def test_abft_field_absent_or_failed_is_supported(workspace):
+    # pre-abft artifacts lack the key; a single-device bench box emits
+    # available: false — neither renders the line
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    assert "ABFT silent-corruption checks" not in readme.read_text()
+    artifact.write_text(json.dumps(make_artifact(
+        abft={"available": False}
+    )))
+    urb.regenerate(str(readme), str(artifact))
+    assert "ABFT silent-corruption checks" not in readme.read_text()
+
+
 def test_throughput_and_coldstart_rendered_when_present(workspace):
     _tmp, readme, artifact = workspace
     rec = make_artifact(

@@ -26,6 +26,10 @@ older artifacts predate newer keys, which must never fail the gate):
 - `precond` rows (keyed grid × engine): `iters` growing more than
   `precond-iters-pct` (operator-determined, like κ) or `t_solver_s`
   more than `precond-t-pct` slower
+- the `abft` row: checks-on overhead creeping more than `abft-pp`
+  percentage points between rounds, or the collective-cadence pin
+  (`collectives_identical`) breaking — bench.py's own ≤2% gate bounds
+  the absolute; this catches the trend
 
 Tolerances live in `pyproject.toml [tool.bench_compare]` (shared by the
 CLI and the driver-dryrun smoke gate); built-in defaults apply when the
@@ -56,6 +60,10 @@ DEFAULT_TOLERANCES = {
     # they get a fractional band; time shares the wall-clock noise floor
     "precond-iters-pct": 0.15,
     "precond-t-pct": 0.25,
+    # abft overhead drift between rounds, in absolute percentage POINTS
+    # (the quantity is already a percent — a fractional band of a small
+    # percent would be noise-tight)
+    "abft-pp": 1.0,
 }
 
 # scalar-row artifact keys carrying {grid, t_solver_s, iters}
@@ -305,6 +313,33 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
                 ))
     if bool(old.get("throughput")) != bool(new.get("throughput")):
         notes.append("throughput: only in one round, skipped")
+
+    # the ABFT overhead row: bench.py's own ≤2% gate bounds the absolute
+    # per round; this catches creep between rounds (percentage POINTS —
+    # the quantity is already a percent) and the cadence pin breaking
+    def live_abft(rec):
+        row = rec.get("abft")
+        return row if isinstance(row, dict) and row.get("available") else None
+
+    o_row, n_row = live_abft(old), live_abft(new)
+    if o_row is not None and n_row is not None:
+        o, n = o_row.get("overhead_pct"), n_row.get("overhead_pct")
+        if not one_sided("abft overhead_pct", "abft", o, n) and \
+                o is not None and n is not None:
+            limit = tol["abft-pp"]
+            if n > o + limit:
+                regressions.append(Regression(
+                    "abft_overhead_pct", "abft", o, n,
+                    f"+{n - o:.2f}pp > +{limit:g}pp overhead creep",
+                ))
+        if n_row.get("collectives_identical") is False:
+            regressions.append(Regression(
+                "abft_collectives", "abft", 1, 0,
+                "checks-on added collectives (the identical-cadence pin "
+                "broke)",
+            ))
+    elif (o_row is None) != (n_row is None):
+        notes.append("abft: only in one round, skipped")
 
     return regressions, notes
 

@@ -387,6 +387,22 @@ def observability_lines(rec: dict) -> list[str]:
                 f"**{pipelined}** — the halved-collectives property, "
                 "regression-checked in every bench artifact."
             )
+    abft = rec.get("abft")
+    if abft and abft.get("available") and abft.get("overhead_pct") is not None:
+        M, N = abft.get("grid", ["?", "?"])
+        pin = (
+            "collective counts identical on/off"
+            if abft.get("collectives_identical")
+            else "COLLECTIVE-CADENCE PIN BROKEN"
+        )
+        lines.append(
+            f"ABFT silent-corruption checks (`resilience.abft`): "
+            f"checks-on overhead **{abft['overhead_pct']:+.2f}%** of "
+            f"T_solver at {M}×{N} (gate ≤{abft.get('gate_pct', 2):g}%), "
+            f"{pin} at {abft.get('psum_per_iter', '?')} psum/iteration — "
+            "every checksum partial rides the existing stacked "
+            "convergence psum."
+        )
     return lines
 
 
