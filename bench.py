@@ -49,12 +49,20 @@ checks):
                 counts pinned IDENTICAL from the jaxpr (every checksum
                 partial rides the existing stacked convergence psum —
                 ``resilience.abft``).
+  geometry    — "geometry" key: the SDF-general assembly study at
+                400×600 — ellipse-via-quadrature vs the closed form
+                (≤1e-12 relative face-fraction error, ±2 iterations,
+                asserted into ``valid``), host f64 assembly overhead,
+                and a composite ellipse-minus-hole solve (converged +
+                discrete maximum principle) as the arbitrary-geometry
+                timing row (``geom.*``).
 """
 
 from __future__ import annotations
 
 import json
 import math
+import statistics
 import sys
 import time
 
@@ -590,6 +598,106 @@ def bench_recovery(grid: tuple[int, int] = (400, 600), oracle: int = 546):
     return row, ok
 
 
+def bench_geometry(grid: tuple[int, int] = (400, 600), oracle: int = 546):
+    """The geometry key: the SDF-general assembly's cost and fidelity.
+
+    Three facts per round, folded into ``valid``:
+
+    - **parity** — the ellipse THROUGH the bisection quadrature matches
+      the closed form to ≤1e-12 relative face fraction, and its f32
+      solve lands within ±2 iterations of the oracle (the
+      closed-form-stays-default acceptance, measured);
+    - **assembly overhead** — host-f64 quadrature assembly time vs the
+      closed form (a one-time setup cost, but it must stay a *setup*
+      cost — regression-gated between rounds);
+    - **composite solve** — an ellipse-minus-hole domain through the
+      validated path: converged, discrete maximum principle held, and
+      its T_solver as the arbitrary-geometry timing row.
+    """
+    import numpy as np
+
+    from poisson_ellipse_tpu.geom import quadrature, sdf
+    from poisson_ellipse_tpu.models import ellipse as ellipse_mod
+    from poisson_ellipse_tpu.ops import assembly as assembly_mod
+    from poisson_ellipse_tpu.solver.engine import build_solver
+    from poisson_ellipse_tpu.utils.timing import fence
+
+    M, N = grid
+    p = Problem(M=M, N=N)
+
+    t0 = time.perf_counter()
+    assembly_mod.assemble_numpy(p)
+    t_cf = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    la, lb = quadrature.segment_lengths(p, sdf.Ellipse())
+    t_quad = time.perf_counter() - t0
+
+    gi = np.arange(M + 1, dtype=np.float64)
+    gj = np.arange(N + 1, dtype=np.float64)
+    x = p.a1 + gi * p.h1
+    y = p.a2 + gj * p.h2
+    xc, yc = x[:, None], y[None, :]
+    la_cf = ellipse_mod.segment_length_vertical(
+        xc - 0.5 * p.h1, yc - 0.5 * p.h2, yc + 0.5 * p.h2, np
+    )
+    lb_cf = ellipse_mod.segment_length_horizontal(
+        yc - 0.5 * p.h2, xc - 0.5 * p.h1, xc + 0.5 * p.h1, np
+    )
+    frac_err = max(
+        float(np.abs(la / p.h2 - la_cf / p.h2).max()),
+        float(np.abs(lb / p.h1 - lb_cf / p.h1).max()),
+    )
+
+    solver, args, _ = build_solver(p, "xla", geometry=sdf.Ellipse())
+    res = solver(*args)
+    fence(res)
+    sdf_iters = int(res.iters)
+
+    composite = sdf.Difference(sdf.Ellipse(), sdf.Circle(r=0.25))
+    solver_c, args_c, _ = build_solver(p, "xla", geometry=composite)
+    res_c = solver_c(*args_c)
+    fence(res_c)  # warm-up: compile + first dispatch out of the timing
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        res_c = solver_c(*args_c)
+        fence(res_c)  # tpulint: disable=TPU008 — timing-protocol fence
+        times.append(time.perf_counter() - t0)
+    w_c = np.asarray(res_c.w)
+    min_u = float(w_c.min())
+
+    ok = (
+        frac_err <= 1e-12
+        and abs(sdf_iters - oracle) <= 2
+        and bool(res_c.converged)
+        and min_u >= -1e-6
+    )
+    row = {
+        "grid": [M, N],
+        "assembly_cf_s": round(t_cf, 5),
+        "assembly_quad_s": round(t_quad, 5),
+        "assembly_overhead_x": round(t_quad / max(t_cf, 1e-9), 2),
+        "max_frac_err": frac_err,
+        "sdf_ellipse_iters": sdf_iters,
+        "oracle_iters": oracle,
+        "composite": {
+            "domain": "ellipse-minus-hole",
+            "t_solver_s": round(statistics.median(times), 5),
+            "iters": int(res_c.iters),
+            "converged": bool(res_c.converged),
+            "min_u": min_u,
+        },
+    }
+    note(
+        f"  [geometry] {M}x{N}: quad-vs-closed-form frac err "
+        f"{frac_err:.2e}, sdf-ellipse {sdf_iters} iters (oracle "
+        f"{oracle}), assembly {t_quad:.3f}s vs {t_cf:.3f}s, composite "
+        f"{row['composite']['t_solver_s']}s/{row['composite']['iters']} "
+        f"iters " + ("— OK" if ok else "— GEOMETRY CHECK FAILED"),
+    )
+    return row, ok
+
+
 # the ABFT healthy-path overhead gate: checks-on vs checks-off T_solver
 # at the headline grid (percent; tools/bench_compare.py diffs the
 # measured overhead between rounds under [tool.bench_compare] abft-pp)
@@ -972,9 +1080,12 @@ def main() -> int:
     # ABFT overhead study: silent-corruption checks on vs off — ≤2%
     # T_solver and identical collective counts (f32, pre-f64-flip)
     abft_row, oka = bench_abft()
+    # geometry study: SDF-quadrature-vs-closed-form parity + overhead
+    # and the composite-domain timing row (f32, pre-f64-flip)
+    geom_row, okg = bench_geometry()
     all_ok &= (
         ok2 & okn & ok8 & okp & okpc & okt & okcs & oksv & oke & okc & okl
-        & oks & okr & oka
+        & oks & okr & oka & okg
     )
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
@@ -1026,6 +1137,10 @@ def main() -> int:
         # ABFT silent-corruption checks: healthy-path overhead (≤2%
         # gate) with the 1-psum/iter cadence pinned identical on vs off
         "abft": abft_row,
+        # SDF geometry: quadrature-vs-closed-form parity (≤1e-12 frac
+        # err, ±2 iters), host assembly overhead, and the composite-
+        # domain (ellipse-minus-hole) solve row (geom.*)
+        "geometry": geom_row,
         "f64": f64_row,
     }
     trace_event("bench_artifact", **record)

@@ -344,7 +344,7 @@ def pcg_batched(problem: Problem, a, b, rhs, mask=None,
 
 
 def batched_operands(problem: Problem, lanes: int, dtype=jnp.float32,
-                     eps_values=None):
+                     eps_values=None, geometry=None, theta=None):
     """Assemble (a, b, rhs) for a ``lanes``-wide batch of this problem.
 
     With ``eps_values`` (length ``lanes``) each lane gets its own
@@ -371,7 +371,8 @@ def batched_operands(problem: Problem, lanes: int, dtype=jnp.float32,
                     a2=problem.a2, b2=problem.b2, f_val=problem.f_val,
                     delta=problem.delta, norm=problem.norm, eps=eps,
                     max_iter=problem.max_iter,
-                )
+                ),
+                geometry=geometry, theta=theta,
             )
             for eps in eps_values
         ]
@@ -380,5 +381,6 @@ def batched_operands(problem: Problem, lanes: int, dtype=jnp.float32,
         b = jnp.asarray(np.stack([x[1] for x in abrs]).astype(np_dtype))
         rhs = jnp.asarray(np.stack([x[2] for x in abrs]).astype(np_dtype))
         return a, b, rhs
-    a, b, rhs = assembly.assemble(problem, dtype)
+    a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
+                                  theta=theta)
     return a, b, jnp.broadcast_to(rhs, (lanes,) + rhs.shape)

@@ -1,0 +1,49 @@
+"""Arbitrary level-set geometry for the fictitious-domain assembly.
+
+PRs 1–9 hardened every layer around a single hard-coded ellipse whose
+face fractions come from a closed form (``models/ellipse.py``). This
+package is the generality — and, inseparably, the defense layer the
+generality makes necessary:
+
+- :mod:`.sdf` — JSON-serializable signed-distance primitives (ellipse,
+  circle, half-plane, rectangle) and boolean/translation composition,
+  evaluated as broadcast array expressions with the same ``xp=`` module
+  convention as ``models.ellipse`` (one geometry, host f64 AND traced).
+- :mod:`.quadrature` — face fractions by adaptive 1-D bisection of the
+  SDF sign change along each cell face, replacing the closed form for
+  arbitrary domains (and matching it to ≤1e-12 relative for the
+  ellipse), plus the **degenerate-cut defense**: fractions within θ of
+  the full/empty endpoints are clamped, reported as
+  ``geom:degenerate-cut`` trace events.
+- :mod:`.validate` — the pre-solve admissibility gate: domain
+  non-empty, resolved by the grid, clear of the Dirichlet ring, and an
+  assembled operator that is finite, symmetric, M-matrix-signed and SPD
+  (host Lanczos probe through ``obs.spectrum``) — failing with the
+  classified :class:`~poisson_ellipse_tpu.resilience.errors.
+  InvalidGeometryError` (exit 8) BEFORE any device loop runs.
+- :mod:`.fuzz` — a seeded property-based harness generating random SDF
+  compositions and checking metamorphic invariants (refinement
+  convergence, discrete maximum principle, reflection symmetry,
+  guard-recoverability when validation is bypassed).
+"""
+
+from poisson_ellipse_tpu.geom.sdf import (  # noqa: F401
+    Circle,
+    Difference,
+    Ellipse,
+    HalfPlane,
+    Intersection,
+    Rectangle,
+    Translate,
+    Union,
+    from_spec,
+    to_spec,
+)
+from poisson_ellipse_tpu.geom.quadrature import (  # noqa: F401
+    DEFAULT_THETA,
+    segment_lengths,
+)
+# the validate/fuzz modules stay addressable as submodules
+# (``geom.validate.validate(...)``): re-exporting the function here
+# would shadow the module attribute of the same name
+from poisson_ellipse_tpu.geom import validate  # noqa: F401

@@ -1,0 +1,351 @@
+"""Seeded property-based geometry fuzzing: the gate earns its keep.
+
+"Handles arbitrary geometry" is unfalsifiable until something hostile
+is thrown at it. This harness generates random SDF compositions (and
+deliberately malformed specs) from one seed and checks *metamorphic*
+invariants — properties that must hold for ANY admissible domain, no
+oracle required:
+
+- **classification totality** — every generated case either passes the
+  admissibility gate or raises the classified ``InvalidGeometryError``;
+  nothing escapes as a raw exception, nothing hangs.
+- **discrete maximum principle** — for f ≥ 0 and an M-matrix operator,
+  the solution satisfies u ≥ 0 (to round-off). The gate's M-matrix
+  check is exactly what makes this theorem apply; fuzzing closes the
+  loop by testing the theorem's conclusion.
+- **reflection symmetry** — a domain symmetric under x → −x on the
+  symmetric grid must produce a solution symmetric to round-off.
+- **refinement convergence** — halving h must move the solution toward
+  a limit: ‖u_h − u_{h/2}‖ is small and shrinks.
+- **guard recoverability** — with validation *bypassed* (the belt-and-
+  suspenders drill), an inadmissible operator handed to
+  ``resilience.guard`` must end in a classified ``SolveError`` or a
+  finite result — never an unclassified crash, never a NaN returned as
+  converged.
+
+Deterministic in ``seed``: a failing case number is a reproducible bug
+report, not an anecdote (the ``serve.chaos`` stance, applied to
+geometry). CLI: ``python -m poisson_ellipse_tpu.geom.fuzz --cases 30``.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.geom import sdf as geom_sdf
+from poisson_ellipse_tpu.geom import validate as geom_validate
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.resilience.errors import (
+    InvalidGeometryError,
+    SolveError,
+)
+
+DEFAULT_GRID = (12, 12)
+DEFAULT_CASES = 30
+
+
+def random_shape(rng: random.Random, depth: int = 0, symmetric: bool = False):
+    """One random SDF tree, sized to sit inside Ω with clearance from
+    the Dirichlet ring (so most cases are admissible and the rejections
+    exercised are the *interesting* ones: slivers, empty intersections,
+    under-resolved spikes). ``symmetric=True`` restricts to shapes even
+    under x → −x (the reflection-invariant corpus)."""
+    cx = 0.0 if symmetric else rng.uniform(-0.25, 0.25)
+    cy = rng.uniform(-0.1, 0.1)
+    kind = rng.randrange(7 if depth < 2 else 4)
+    if kind == 0:
+        return geom_sdf.Ellipse(
+            cx=cx, cy=cy,
+            rx=rng.uniform(0.3, 0.65), ry=rng.uniform(0.15, 0.3),
+        )
+    if kind == 1:
+        return geom_sdf.Circle(cx=cx, cy=cy, r=rng.uniform(0.15, 0.3))
+    if kind == 2:
+        hw = rng.uniform(0.2, 0.6)
+        hh = rng.uniform(0.12, 0.3)
+        return geom_sdf.Rectangle(
+            x0=cx - hw, y0=cy - hh, x1=cx + hw, y1=cy + hh
+        )
+    if kind == 3:
+        base = geom_sdf.Ellipse(
+            cx=cx, cy=cy,
+            rx=rng.uniform(0.4, 0.7), ry=rng.uniform(0.18, 0.3),
+        )
+        hole = geom_sdf.Circle(
+            cx=cx, cy=cy, r=rng.uniform(0.05, 0.12)
+        )
+        return geom_sdf.Difference(base, hole)
+    if kind == 4:
+        a = random_shape(rng, depth + 1, symmetric)
+        b = random_shape(rng, depth + 1, symmetric)
+        return geom_sdf.Union(a, b)
+    if kind == 5:
+        a = random_shape(rng, depth + 1, symmetric)
+        b = random_shape(rng, depth + 1, symmetric)
+        return geom_sdf.Intersection(a, b)
+    return geom_sdf.Translate(
+        random_shape(rng, depth + 1, symmetric),
+        dx=0.0 if symmetric else rng.uniform(-0.15, 0.15),
+        dy=rng.uniform(-0.08, 0.08),
+    )
+
+
+def malformed_spec(rng: random.Random) -> dict:
+    """One deliberately broken JSON spec (the admission fuzz corpus —
+    every one must be rejected as ``malformed-spec``)."""
+    choice = rng.randrange(6)
+    if choice == 0:
+        return {"kind": "dodecahedron"}
+    if choice == 1:
+        return {"kind": "circle", "r": -0.2}
+    if choice == 2:
+        return {"kind": "ellipse", "rx": float("nan")}
+    if choice == 3:
+        return {"kind": "union", "shapes": []}
+    if choice == 4:
+        return {"kind": "rectangle", "x0": 0.5, "x1": -0.5}
+    spec: dict = {"kind": "translate", "dx": 0.0, "dy": 0.0}
+    leaf = spec
+    for _ in range(geom_sdf.MAX_SPEC_DEPTH + 2):
+        leaf["shape"] = {"kind": "translate", "dx": 0.0, "dy": 0.0}
+        leaf = leaf["shape"]
+    leaf["shape"] = {"kind": "circle"}
+    return spec
+
+
+def inadmissible_shape(rng: random.Random):
+    """One structurally valid but *inadmissible* shape (empty, escaping
+    Ω, or thinner than any grid) — the gate-rejection corpus."""
+    choice = rng.randrange(3)
+    if choice == 0:  # disjoint intersection -> empty
+        return geom_sdf.Intersection(
+            geom_sdf.Circle(cx=-0.5, cy=0.0, r=0.15),
+            geom_sdf.Circle(cx=0.5, cy=0.0, r=0.15),
+        )
+    if choice == 1:  # pokes through the Dirichlet ring
+        return geom_sdf.Circle(cx=0.9, cy=0.0, r=0.3)
+    # a hair: thinner than h on any tier-1 grid
+    return geom_sdf.Rectangle(x0=-0.5, y0=1e-4, x1=0.5, y1=2.1e-4)
+
+
+# no donation: the refinement check re-feeds the same operands, and the
+# fuzz sweep's grids are tiny
+@functools.partial(jax.jit, static_argnums=0)  # tpulint: disable=TPU004
+def _solve_operands(problem: Problem, a, b, rhs):
+    # one compile per (problem, shape/dtype) across the whole fuzz run —
+    # the jit cache keys on the static problem + operand shapes
+    from poisson_ellipse_tpu.solver.pcg import pcg
+
+    return pcg(problem, a, b, rhs)
+
+
+def _solve(problem: Problem, shape, theta=None):
+    # the metamorphic invariants are f64 statements (x64 is on in every
+    # harness that runs the fuzz — conftest, the CLI's default CPU run)
+    a, b, rhs = assembly.assemble(
+        # tpulint: disable=TPU001 — f64-on-purpose, see above
+        problem, jnp.float64, geometry=shape, theta=theta
+    )
+    return _solve_operands(problem, a, b, rhs)
+
+
+def check_solution_invariants(problem: Problem, shape, theta=None,
+                              symmetric: bool = False) -> dict:
+    """Solve one admissible case and assert the metamorphic properties
+    (maximum principle; reflection symmetry when claimed)."""
+    result = _solve(problem, shape, theta)
+    w = np.asarray(result.w)
+    if not bool(result.converged):
+        raise AssertionError(
+            f"admissible domain did not converge in {int(result.iters)} "
+            "iterations"
+        )
+    floor = float(w.min())
+    if floor < -1e-8:
+        raise AssertionError(
+            f"discrete maximum principle violated: min u = {floor:g} < 0 "
+            "for f >= 0 on an M-matrix operator"
+        )
+    out = {"iters": int(result.iters), "min_u": floor,
+           "max_u": float(w.max())}
+    if symmetric:
+        asym = float(np.abs(w - w[::-1, :]).max())
+        scale = max(float(np.abs(w).max()), 1e-30)
+        if asym > 1e-8 * scale:
+            raise AssertionError(
+                f"reflection symmetry violated: max |u - u_mirror| = "
+                f"{asym:g} on a symmetric domain"
+            )
+        out["mirror_defect"] = asym
+    return out
+
+
+def check_refinement(problem: Problem, shape, theta=None) -> dict:
+    """‖u_h − u_{h/2}‖ must be small and shrink under refinement."""
+    coarse = np.asarray(_solve(problem, shape, theta).w)
+    fine_p = Problem(
+        M=2 * problem.M, N=2 * problem.N, delta=problem.delta,
+        norm=problem.norm,
+    )
+    fine = np.asarray(_solve(fine_p, shape, theta).w)
+    scale = max(float(np.abs(fine).max()), 1e-30)
+    d1 = float(np.abs(fine[::2, ::2] - coarse).max()) / scale
+    if d1 > 0.5:
+        raise AssertionError(
+            f"refinement divergence: relative coarse-vs-fine gap {d1:g}"
+        )
+    return {"rel_gap": d1}
+
+
+def check_guard_recoverability(problem: Problem, shape) -> str:
+    """Bypass the gate and hand the (inadmissible) operator to the
+    guard: the outcome must be a classified SolveError or a finite
+    result — the drill for a validation layer that was skipped."""
+    from poisson_ellipse_tpu.resilience.guard import guarded_solve
+
+    try:
+        guarded = guarded_solve(
+            # tpulint: disable=TPU001 — f64-on-purpose (see _solve)
+            problem, "xla", jnp.float64, geometry=shape,
+            validate_geometry=False,
+        )
+    except SolveError as e:
+        return f"classified:{e.classification}"
+    w = np.asarray(guarded.result.w)
+    if bool(guarded.result.converged) and not np.isfinite(w).all():
+        raise AssertionError(
+            "guard returned a non-finite iterate as converged"
+        )
+    return "finite-result"
+
+
+def run_fuzz(n_cases: int = DEFAULT_CASES, seed: int = 0,
+             grid: tuple[int, int] = DEFAULT_GRID,
+             solve_budget: int = 4) -> dict:
+    """The full seeded sweep; returns a JSON-able report and raises
+    AssertionError on the first violated invariant.
+
+    Case mix per 6: one malformed spec, one inadmissible shape, four
+    random shapes (one forced symmetric). Solves are bounded by
+    ``solve_budget`` admissible cases (+1 refinement pair, +1 guard
+    drill) so the sweep stays tier-1-sized; classification runs on
+    every case.
+    """
+    rng = random.Random(seed)
+    problem = Problem(M=grid[0], N=grid[1])
+    report: dict = {
+        "seed": seed, "cases": n_cases, "grid": list(grid),
+        "accepted": 0, "rejected": {}, "solved": 0, "details": [],
+    }
+    solves_left = solve_budget
+    refinement_done = False
+    guard_done = False
+    for i in range(n_cases):
+        slot = i % 6
+        entry: dict = {"case": i}
+        if slot == 0:
+            spec = malformed_spec(rng)
+            try:
+                geom_validate.validate(problem, spec)
+            except InvalidGeometryError as e:
+                entry["outcome"] = f"rejected:{e.reason}"
+                if e.reason != "malformed-spec":
+                    raise AssertionError(
+                        f"case {i}: malformed spec classified {e.reason}, "
+                        "expected malformed-spec"
+                    )
+                report["rejected"][e.reason] = (
+                    report["rejected"].get(e.reason, 0) + 1
+                )
+            else:
+                raise AssertionError(
+                    f"case {i}: malformed spec passed the gate: {spec}"
+                )
+            report["details"].append(entry)
+            continue
+        symmetric = slot == 2
+        shape = (
+            inadmissible_shape(rng) if slot == 1
+            else random_shape(rng, symmetric=symmetric)
+        )
+        entry["spec"] = geom_sdf.to_spec(shape)
+        try:
+            geom_validate.validate(problem, shape)
+        except InvalidGeometryError as e:
+            entry["outcome"] = f"rejected:{e.reason}"
+            report["rejected"][e.reason] = (
+                report["rejected"].get(e.reason, 0) + 1
+            )
+            if slot == 1 and not guard_done and solve_budget > 0:
+                entry["guard"] = check_guard_recoverability(problem, shape)
+                guard_done = True
+        else:
+            report["accepted"] += 1
+            entry["outcome"] = "accepted"
+            if slot == 1:
+                raise AssertionError(
+                    f"case {i}: inadmissible shape passed the gate: "
+                    f"{entry['spec']}"
+                )
+            if solves_left > 0:
+                entry.update(check_solution_invariants(
+                    problem, shape, symmetric=symmetric
+                ))
+                report["solved"] += 1
+                solves_left -= 1
+                if not refinement_done:
+                    entry["refinement"] = check_refinement(problem, shape)
+                    refinement_done = True
+        report["details"].append(entry)
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.geom.fuzz",
+        description="Seeded geometry fuzzing: random SDF compositions "
+        "through the admissibility gate + metamorphic solve invariants "
+        "(maximum principle, reflection symmetry, refinement "
+        "convergence, guard recoverability). Exit 0 iff every invariant "
+        "holds.",
+    )
+    ap.add_argument("--cases", type=int, default=DEFAULT_CASES)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--grid", default="12x12", help="MxN fuzz grid")
+    ap.add_argument("--solve-budget", type=int, default=4)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    # the metamorphic tolerances are f64 statements (see _solve); the
+    # standalone CLI must flip x64 itself — pytest gets it from conftest
+    jax.config.update("jax_enable_x64", True)
+    M, _, N = args.grid.partition("x")
+    try:
+        report = run_fuzz(
+            n_cases=args.cases, seed=args.seed,
+            grid=(int(M), int(N or M)), solve_budget=args.solve_budget,
+        )
+    except AssertionError as e:
+        print(f"FUZZ FAILURE: {e}")
+        return 1
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(
+            f"fuzz: {report['cases']} cases, {report['accepted']} "
+            f"accepted ({report['solved']} solved, all invariants held), "
+            f"rejections: {report['rejected']}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,216 @@
+"""Face fractions by adaptive 1-D bisection of the SDF along cell faces.
+
+The reference's assembly needs exactly one geometric fact per face: the
+length of the face's intersection with the domain D
+(``stage0/Withoutopenmp1.cpp:19-39`` computes it in closed form for the
+ellipse). For an arbitrary SDF composition no closed form exists, so
+this module replaces it with 1-D root finding along each face:
+
+1. sample the level set at ``samples``+1 points along the face;
+2. bracket every sign change and bisect it to ~2⁻⁶⁰ of the face length
+   (below f64 resolution of O(1) coordinates — the ellipse through this
+   path matches ``models.ellipse.segment_length_*`` to ≤1e-12 relative);
+3. subintervals whose endpoints agree in sign but whose level values are
+   small enough to hide a crossing pair (the |φ| < Lipschitz·Δt test)
+   are re-sampled at ``refine``× resolution first — the *adaptive* part,
+   which catches near-tangent faces and thin walls/slivers a uniform
+   sweep would mis-measure.
+
+Everything runs on the HOST in float64 over vectorised numpy — the same
+rounded-once fidelity stance as ``ops.assembly.assemble_numpy`` (the
+cut-face blend amplifies fraction noise by 1/ε), and the purity contract
+tpulint TPU015 fences: no traced values are round-tripped here because
+nothing here is traced.
+
+The degenerate-cut defense lives at this layer too:
+:func:`clamp_lengths` snaps cut fractions within θ of the empty/full
+endpoints to exactly empty/full. A sliver cut (fraction → 0 under a
+weak-penalty ε) couples two regions through a conductance ~fraction,
+putting a λ ~ fraction eigenvalue into D⁻¹A — κ ~ 1/fraction, and
+diag-PCG stalls (the CutFEM small-cut pathology; Burman–Hansbo's ghost
+penalty solves it variationally, clamping is the finite-volume
+equivalent). The clamp is *reported*, never silent: ``ops.assembly``
+emits a ``geom:degenerate-cut`` trace event with the counts, and the
+κ(M⁻¹A) impact is measurable through ``obs.spectrum``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from poisson_ellipse_tpu.models.problem import Problem
+
+# cut-fraction clamp threshold: fractions in (0, θ) snap to empty,
+# (1−θ, 1) snap to full. 1e-6 of a face is far below any feature the
+# admissibility gate's resolution check admits, so the clamp only ever
+# removes slivers the discretisation could not represent anyway.
+DEFAULT_THETA = 1e-6
+
+# initial uniform samples per face; the suspicious-subinterval pass
+# refines by REFINE where the level values could hide a crossing pair
+DEFAULT_SAMPLES = 16
+REFINE = 32
+BISECT_ITERS = 60
+
+# host-memory bound for the vectorised sweep: faces are processed in
+# chunks of this many level-set evaluations
+_CHUNK_EVALS = 2_000_000
+
+
+def _bisect(sdf, x0, y0, ux, uy, seg_len, tlo, thi, lo_inside):
+    """Bisect the bracketed sign change of φ along t ∈ [tlo, thi] (face
+    parameter) to ~(thi−tlo)·2⁻⁶⁰; all arrays are per-crossing."""
+    tlo = tlo.copy()
+    thi = thi.copy()
+    for _ in range(BISECT_ITERS):
+        tm = 0.5 * (tlo + thi)
+        mid_inside = (
+            sdf(x0 + ux * seg_len * tm, y0 + uy * seg_len * tm, np) < 0.0
+        )
+        same = mid_inside == lo_inside
+        tlo = np.where(same, tm, tlo)
+        thi = np.where(same, thi, tm)
+    return 0.5 * (tlo + thi)
+
+
+def _piece_lengths(sdf, x0, y0, ux, uy, seg_len, t, phi):
+    """Inside-length (in t units, face ∈ [0, 1]) from sampled level
+    values ``phi`` (n, K+1) at face parameters ``t`` (K+1,)."""
+    inside = phi < 0.0
+    dt = t[1] - t[0]
+    left, right = inside[:, :-1], inside[:, 1:]
+    contrib = np.where(left & right, dt, 0.0)
+
+    rows, cols = np.nonzero(left != right)
+    if rows.size:
+        tstar = _bisect(
+            sdf, x0[rows], y0[rows], ux, uy, seg_len,
+            t[cols], t[cols + 1], left[rows, cols],
+        )
+        contrib[rows, cols] = np.where(
+            left[rows, cols], tstar - t[cols], t[cols + 1] - tstar
+        )
+    return contrib
+
+
+def _lengths_along(sdf, x0, y0, ux, uy, seg_len,
+                   samples: int = DEFAULT_SAMPLES) -> np.ndarray:
+    """Length of {face_k} ∩ D for n parallel faces of length ``seg_len``
+    starting at (x0[k], y0[k]) along unit direction (ux, uy)."""
+    if seg_len <= 0:
+        return np.zeros_like(x0)
+    t = np.linspace(0.0, 1.0, samples + 1)
+    phi = sdf(
+        x0[:, None] + ux * seg_len * t[None, :],
+        y0[:, None] + uy * seg_len * t[None, :],
+        np,
+    )
+    phi = np.asarray(phi, dtype=np.float64)
+    contrib = _piece_lengths(sdf, x0, y0, ux, uy, seg_len, t, phi)
+
+    # adaptive pass: a same-sign subinterval can hide an even number of
+    # crossings only if the level set dips through zero between samples —
+    # which needs |φ| at both endpoints below ~the subinterval's length
+    # (the primitives scale like distance near their boundary; 2× covers
+    # composition slack). Those are re-resolved at REFINE× resolution.
+    dt = t[1] - t[0]
+    inside = phi < 0.0
+    same_sign = inside[:, :-1] == inside[:, 1:]
+    small = np.minimum(np.abs(phi[:, :-1]), np.abs(phi[:, 1:])) < (
+        2.0 * seg_len * dt
+    )
+    rows, cols = np.nonzero(same_sign & small)
+    if rows.size:
+        tf = np.linspace(0.0, 1.0, REFINE + 1)
+        sub_t = t[cols][:, None] + dt * tf[None, :]
+        phi_f = np.asarray(
+            sdf(
+                x0[rows][:, None] + ux * seg_len * sub_t,
+                y0[rows][:, None] + uy * seg_len * sub_t,
+                np,
+            ),
+            dtype=np.float64,
+        )
+        # per-suspicious-subinterval inside length via the same machinery
+        # on the refined grid (absolute t values vary per row, so pass
+        # per-row offsets through the coordinate arrays instead)
+        fine = np.zeros(rows.size)
+        f_inside = phi_f < 0.0
+        fl, fr = f_inside[:, :-1], f_inside[:, 1:]
+        fdt = dt / REFINE
+        fine += (fl & fr).sum(axis=1) * fdt
+        crows, ccols = np.nonzero(fl != fr)
+        if crows.size:
+            tstar = _bisect(
+                sdf, x0[rows][crows], y0[rows][crows], ux, uy, seg_len,
+                sub_t[crows, ccols], sub_t[crows, ccols + 1],
+                fl[crows, ccols],
+            )
+            piece = np.where(
+                fl[crows, ccols],
+                tstar - sub_t[crows, ccols],
+                sub_t[crows, ccols + 1] - tstar,
+            )
+            np.add.at(fine, crows, piece)
+        contrib[rows, cols] = fine
+    return contrib.sum(axis=1) * seg_len
+
+
+def _chunked(fn, x0, y0, samples):
+    """Apply a per-face sweep in host-memory-bounded chunks."""
+    n = x0.size
+    step = max(1, _CHUNK_EVALS // (samples + 1))
+    if n <= step:
+        return fn(x0, y0)
+    out = np.empty(n)
+    for lo in range(0, n, step):
+        hi = min(lo + step, n)
+        out[lo:hi] = fn(x0[lo:hi], y0[lo:hi])
+    return out
+
+
+def segment_lengths(problem: Problem, sdf,
+                    samples: int = DEFAULT_SAMPLES):
+    """(la, lb) float64 (M+1, N+1): the quadrature twin of the ellipse
+    closed forms, for any SDF.
+
+    ``la[i, j]`` is the length of the vertical face x = x_i − h1/2,
+    y ∈ [y_j − h2/2, y_j + h2/2] inside D; ``lb[i, j]`` the horizontal
+    face's — exactly the face layout ``ops.assembly`` blends
+    (``stage0/Withoutopenmp1.cpp:49-54``). The whole node grid is
+    evaluated; the caller masks the valid range, as the closed-form path
+    does.
+    """
+    M, N = problem.M, problem.N
+    h1, h2 = problem.h1, problem.h2
+    gi = np.arange(M + 1, dtype=np.float64)
+    gj = np.arange(N + 1, dtype=np.float64)
+    x = problem.a1 + gi * h1
+    y = problem.a2 + gj * h2
+
+    shape = (M + 1, N + 1)
+    # vertical faces: start at (x_i − h1/2, y_j − h2/2), run along +y
+    xv = np.broadcast_to((x - 0.5 * h1)[:, None], shape).ravel()
+    yv = np.broadcast_to((y - 0.5 * h2)[None, :], shape).ravel()
+    la = _chunked(
+        lambda a, b: _lengths_along(sdf, a, b, 0.0, 1.0, h2, samples),
+        xv, yv, samples,
+    ).reshape(shape)
+    # horizontal faces: start at (x_i − h1/2, y_j − h2/2), run along +x
+    lb = _chunked(
+        lambda a, b: _lengths_along(sdf, a, b, 1.0, 0.0, h1, samples),
+        xv, yv, samples,
+    ).reshape(shape)
+    return la, lb
+
+
+def clamp_lengths(lengths: np.ndarray, h: float, theta: float):
+    """The degenerate-cut defense: snap fractions in (0, θ) to empty and
+    (1−θ, 1) to full. Returns ``(clamped, n_to_empty, n_to_full)`` so
+    the caller can *report* every clamp (``geom:degenerate-cut``);
+    ``theta=0`` disables (and reports zero)."""
+    frac = lengths / h
+    to_empty = (frac > 0.0) & (frac < theta)
+    to_full = (frac < 1.0) & (frac > 1.0 - theta)
+    clamped = np.where(to_empty, 0.0, np.where(to_full, h, lengths))
+    return clamped, int(to_empty.sum()), int(to_full.sum())

@@ -1,0 +1,289 @@
+"""JSON-serializable signed-distance primitives and their composition.
+
+Each shape is a frozen dataclass callable as ``shape(x, y, xp=jnp)``
+over broadcast coordinate arrays, returning a level-set value that is
+**negative strictly inside** the domain, positive outside, ~0 on the
+boundary — the same ``xp=`` array-module convention as
+``models.ellipse``, so one definition serves the float64 host assembly
+path (``xp=numpy``) and any traced consumer (``xp=jnp``). Values near
+the boundary scale like geometric distance (exact for circle/half-plane,
+a monotone proxy for ellipse/rectangle), which is all the bisection
+quadrature (:mod:`.quadrature`) and the resolution checks
+(:mod:`.validate`) need: a *sign-correct, Lipschitz-on-faces* implicit
+function.
+
+The wire form (``to_spec``/``from_spec``) is a flat JSON tree — the
+shape a serving request can carry, a journal can replay, and a fuzzer
+can mutate. ``from_spec`` is the FIRST rung of the admissibility gate:
+a malformed tree raises the classified
+:class:`~poisson_ellipse_tpu.resilience.errors.InvalidGeometryError`
+(reason ``malformed-spec``, exit 8) instead of a raw KeyError a serving
+lane would have to guess at.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.models.ellipse import safe_sqrt
+from poisson_ellipse_tpu.resilience.errors import InvalidGeometryError
+
+# recursion guard for from_spec: a hostile/buggy spec must fail fast,
+# not blow the interpreter stack
+MAX_SPEC_DEPTH = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class Ellipse:
+    """{((x-cx)/rx)² + ((y-cy)/ry)² < 1}; the default is the reference
+    domain D = {x² + 4y² < 1} (rx=1, ry=1/2)."""
+
+    cx: float = 0.0
+    cy: float = 0.0
+    rx: float = 1.0
+    ry: float = 0.5
+
+    def __call__(self, x, y, xp=jnp):
+        dx = (x - self.cx) / self.rx
+        dy = (y - self.cy) / self.ry
+        return safe_sqrt(dx * dx + dy * dy, xp) - 1.0
+
+    def to_spec(self) -> dict:
+        return {"kind": "ellipse", "cx": self.cx, "cy": self.cy,
+                "rx": self.rx, "ry": self.ry}
+
+
+@dataclasses.dataclass(frozen=True)
+class Circle:
+    """Exact SDF of the disc of radius r at (cx, cy)."""
+
+    cx: float = 0.0
+    cy: float = 0.0
+    r: float = 0.25
+
+    def __call__(self, x, y, xp=jnp):
+        dx = x - self.cx
+        dy = y - self.cy
+        return safe_sqrt(dx * dx + dy * dy, xp) - self.r
+
+    def to_spec(self) -> dict:
+        return {"kind": "circle", "cx": self.cx, "cy": self.cy, "r": self.r}
+
+
+@dataclasses.dataclass(frozen=True)
+class HalfPlane:
+    """{nx·x + ny·y + offset < 0} — exact SDF for a unit normal (the
+    constructor spec normalises)."""
+
+    nx: float = 1.0
+    ny: float = 0.0
+    offset: float = 0.0
+
+    def __call__(self, x, y, xp=jnp):
+        norm = math.hypot(self.nx, self.ny)
+        return (self.nx * x + self.ny * y + self.offset) / norm
+
+    def to_spec(self) -> dict:
+        return {"kind": "halfplane", "nx": self.nx, "ny": self.ny,
+                "offset": self.offset}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rectangle:
+    """The axis-aligned box (x0, x1) × (y0, y1), max-norm level set."""
+
+    x0: float = -0.5
+    y0: float = -0.25
+    x1: float = 0.5
+    y1: float = 0.25
+
+    def __call__(self, x, y, xp=jnp):
+        return xp.maximum(
+            xp.maximum(self.x0 - x, x - self.x1),
+            xp.maximum(self.y0 - y, y - self.y1),
+        )
+
+    def to_spec(self) -> dict:
+        return {"kind": "rectangle", "x0": self.x0, "y0": self.y0,
+                "x1": self.x1, "y1": self.y1}
+
+
+@dataclasses.dataclass(frozen=True, init=False)
+class Union:
+    """min over children: inside any."""
+
+    shapes: Tuple[object, ...]
+
+    def __init__(self, *shapes):
+        # accept both Union(a, b) and Union((a, b))
+        if len(shapes) == 1 and isinstance(shapes[0], tuple):
+            shapes = shapes[0]
+        object.__setattr__(self, "shapes", tuple(shapes))
+
+    def __call__(self, x, y, xp=jnp):
+        out = self.shapes[0](x, y, xp)
+        for s in self.shapes[1:]:
+            out = xp.minimum(out, s(x, y, xp))
+        return out
+
+    def to_spec(self) -> dict:
+        return {"kind": "union", "shapes": [s.to_spec() for s in self.shapes]}
+
+
+@dataclasses.dataclass(frozen=True, init=False)
+class Intersection:
+    """max over children: inside all."""
+
+    shapes: Tuple[object, ...]
+
+    def __init__(self, *shapes):
+        # accept both Intersection(a, b) and Intersection((a, b))
+        if len(shapes) == 1 and isinstance(shapes[0], tuple):
+            shapes = shapes[0]
+        object.__setattr__(self, "shapes", tuple(shapes))
+
+    def __call__(self, x, y, xp=jnp):
+        out = self.shapes[0](x, y, xp)
+        for s in self.shapes[1:]:
+            out = xp.maximum(out, s(x, y, xp))
+        return out
+
+    def to_spec(self) -> dict:
+        return {
+            "kind": "intersection",
+            "shapes": [s.to_spec() for s in self.shapes],
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class Difference:
+    """a minus b: max(a, −b)."""
+
+    a: object
+    b: object
+
+    def __call__(self, x, y, xp=jnp):
+        return xp.maximum(self.a(x, y, xp), -self.b(x, y, xp))
+
+    def to_spec(self) -> dict:
+        return {"kind": "difference", "a": self.a.to_spec(),
+                "b": self.b.to_spec()}
+
+
+@dataclasses.dataclass(frozen=True)
+class Translate:
+    """The child shape shifted by (dx, dy)."""
+
+    shape: object
+    dx: float = 0.0
+    dy: float = 0.0
+
+    def __call__(self, x, y, xp=jnp):
+        return self.shape(x - self.dx, y - self.dy, xp)
+
+    def to_spec(self) -> dict:
+        return {"kind": "translate", "shape": self.shape.to_spec(),
+                "dx": self.dx, "dy": self.dy}
+
+
+def is_inside(shape, x, y, xp=jnp):
+    """Open-domain membership: level set strictly negative."""
+    return shape(x, y, xp) < 0.0
+
+
+def to_spec(shape) -> dict:
+    """The JSON tree of ``shape`` (the serving/journal wire form)."""
+    return shape.to_spec()
+
+
+def _malformed(msg: str) -> InvalidGeometryError:
+    return InvalidGeometryError(
+        f"malformed geometry spec: {msg}", reason="malformed-spec"
+    )
+
+
+def _number(spec: dict, key: str, default=None) -> float:
+    if key not in spec:
+        if default is None:
+            raise _malformed(f"{spec.get('kind')!r} is missing {key!r}")
+        return float(default)
+    v = spec[key]
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise _malformed(f"{key!r} must be a number, got {v!r}")
+    v = float(v)
+    if not math.isfinite(v):
+        raise _malformed(f"{key!r} must be finite, got {v!r}")
+    return v
+
+
+def _positive(spec: dict, key: str, default=None) -> float:
+    v = _number(spec, key, default)
+    if v <= 0:
+        raise _malformed(f"{key!r} must be > 0, got {v!r}")
+    return v
+
+
+def from_spec(spec, _depth: int = 0):
+    """Rebuild an SDF tree from its JSON form; the gate's first rung.
+
+    Every structural defect — not a dict, unknown ``kind``, missing or
+    non-finite parameters, zero radii, degenerate boxes, empty
+    composites, over-deep nesting — raises the classified
+    :class:`InvalidGeometryError` (reason ``malformed-spec``). Nothing
+    past this function ever sees a half-parsed geometry.
+    """
+    if _depth > MAX_SPEC_DEPTH:
+        raise _malformed(f"nesting deeper than {MAX_SPEC_DEPTH}")
+    if not isinstance(spec, dict):
+        raise _malformed(f"expected an object, got {type(spec).__name__}")
+    kind = spec.get("kind")
+    if kind == "ellipse":
+        return Ellipse(
+            cx=_number(spec, "cx", 0.0), cy=_number(spec, "cy", 0.0),
+            rx=_positive(spec, "rx", 1.0), ry=_positive(spec, "ry", 0.5),
+        )
+    if kind == "circle":
+        return Circle(
+            cx=_number(spec, "cx", 0.0), cy=_number(spec, "cy", 0.0),
+            r=_positive(spec, "r", 0.25),
+        )
+    if kind == "halfplane":
+        nx = _number(spec, "nx", 1.0)
+        ny = _number(spec, "ny", 0.0)
+        if nx == 0.0 and ny == 0.0:
+            raise _malformed("halfplane normal must be nonzero")
+        return HalfPlane(nx=nx, ny=ny, offset=_number(spec, "offset", 0.0))
+    if kind == "rectangle":
+        x0, x1 = _number(spec, "x0", -0.5), _number(spec, "x1", 0.5)
+        y0, y1 = _number(spec, "y0", -0.25), _number(spec, "y1", 0.25)
+        if x0 >= x1 or y0 >= y1:
+            raise _malformed(
+                f"rectangle needs x0 < x1 and y0 < y1, got "
+                f"({x0}, {y0})..({x1}, {y1})"
+            )
+        return Rectangle(x0=x0, y0=y0, x1=x1, y1=y1)
+    if kind in ("union", "intersection"):
+        shapes = spec.get("shapes")
+        if not isinstance(shapes, (list, tuple)) or not shapes:
+            raise _malformed(f"{kind!r} needs a non-empty 'shapes' list")
+        children = tuple(from_spec(s, _depth + 1) for s in shapes)
+        return (Union if kind == "union" else Intersection)(*children)
+    if kind == "difference":
+        if "a" not in spec or "b" not in spec:
+            raise _malformed("'difference' needs 'a' and 'b'")
+        return Difference(
+            a=from_spec(spec["a"], _depth + 1),
+            b=from_spec(spec["b"], _depth + 1),
+        )
+    if kind == "translate":
+        if "shape" not in spec:
+            raise _malformed("'translate' needs 'shape'")
+        return Translate(
+            shape=from_spec(spec["shape"], _depth + 1),
+            dx=_number(spec, "dx", 0.0), dy=_number(spec, "dy", 0.0),
+        )
+    raise _malformed(f"unknown kind {kind!r}")

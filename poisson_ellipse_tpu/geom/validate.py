@@ -1,0 +1,303 @@
+"""The pre-solve admissibility gate: reject bad problems BEFORE dispatch.
+
+A serving stack cannot afford to discover mid-batch that a request's
+geometry was garbage — a poisoned lane stalls its whole bucket. So every
+way an arbitrary SDF can make the fictitious-domain problem unsolvable
+is checked here, on HOST float64 arrays (the purity contract tpulint
+TPU015 fences: validation never round-trips a traced value because it
+never touches one), and failure is the classified
+:class:`~poisson_ellipse_tpu.resilience.errors.InvalidGeometryError`
+(exit 8) with a machine-readable ``reason`` — raised before any device
+loop runs.
+
+The checks, in rejection order (each reason documented on the error
+class):
+
+1. **spec** — a dict geometry parses through ``sdf.from_spec``
+   (``malformed-spec``).
+2. **level set** — finite on Ω (``sdf-nonfinite``).
+3. **existence/resolution** — the domain has interior at 4×-refined
+   sampling (``empty-domain``); every such region is visible to the
+   node lattice (``under-resolved``: a feature thinner than h would
+   make the discrete solve silently answer a different question — the
+   gate refuses instead).
+4. **containment** — D must not poke through the Dirichlet ring of Ω
+   (``boundary-contact``; tangency, like the reference ellipse's
+   (±1, 0), is allowed — strict interior crossing is not).
+5. **operator** — the assembled coefficients are finite
+   (``operator-nonfinite``), carry the 5-point M-matrix sign structure
+   (``operator-not-m-matrix``), define a symmetric form
+   (``operator-asymmetric``), and the preconditioned operator D⁻¹A is
+   SPD by a short host Lanczos probe read through the EXISTING
+   ``obs.spectrum`` reconstruction (``operator-not-spd``).
+
+``validate`` returns a JSON-able report on acceptance so callers
+(serving admission, ``harness --geometry``, the bench) can log what was
+checked, including the probe's Ritz interval.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.stencil import apply_a_block, diag_d_block
+from poisson_ellipse_tpu.resilience.errors import InvalidGeometryError
+
+# fine-sampling refinement per cell for the existence/resolution/
+# containment checks; 4 subsamples see any feature wider than h/4
+RESOLUTION_REFINE = 4
+
+# host Lanczos probe length (diag-PCG steps recorded for obs.spectrum);
+# enough for the extremal Ritz values to certify sign-definiteness
+LANCZOS_STEPS = 24
+
+# numeric slack for the symmetry probe: f64 round-off over two stencil
+# applications and two O(MN) reductions
+_SYMMETRY_RTOL = 1e-10
+
+
+def _fail(reason: str, msg: str):
+    raise InvalidGeometryError(f"{msg} [{reason}]", reason=reason)
+
+
+def _apply_a_np(w, a, b, h1, h2):
+    """Host-numpy A·w on the full node grid: ``apply_a_block`` is pure
+    slicing arithmetic, so it serves numpy exactly as it serves jnp."""
+    return np.pad(apply_a_block(w, a, b, h1, h2), 1)
+
+
+def _fine_points(problem: Problem, refine: int):
+    """Cell-interior sample coordinates at ``refine``× resolution:
+    (refine·M,) x and (refine·N,) y, each point strictly inside its cell."""
+    off = (np.arange(refine, dtype=np.float64) + 0.5) / refine
+    xi = problem.a1 + (
+        np.arange(problem.M, dtype=np.float64)[:, None] + off[None, :]
+    ).ravel() * problem.h1
+    yj = problem.a2 + (
+        np.arange(problem.N, dtype=np.float64)[:, None] + off[None, :]
+    ).ravel() * problem.h2
+    return xi, yj
+
+
+def _dilate(cells: np.ndarray) -> np.ndarray:
+    """3×3 binary dilation by shifted ORs (no scipy dependency)."""
+    out = cells.copy()
+    out[1:, :] |= cells[:-1, :]
+    out[:-1, :] |= cells[1:, :]
+    grown = out.copy()
+    grown[:, 1:] |= out[:, :-1]
+    grown[:, :-1] |= out[:, 1:]
+    return grown
+
+
+def _lanczos_probe(problem: Problem, a, b, rhs, steps: int):
+    """A short host-f64 diagonal-PCG on the assembled operator,
+    recording (zr, diff, α, β) in exactly the ``obs.convergence`` trace
+    convention — so the EXISTING Lanczos reconstruction of
+    ``obs.spectrum`` turns it into Ritz values of D⁻¹A. Returns
+    ``(trace_dict, failure_reason_or_None)``.
+
+    A breakdown pivot ((Ap, p) ≤ 0 with p ≠ 0) or a non-positive
+    preconditioned energy (z, r) ≤ 0 before convergence is a direct
+    indefiniteness witness, reported without waiting for the Ritz pass.
+    """
+    h1, h2 = problem.h1, problem.h2
+    d = np.pad(diag_d_block(a, b, h1, h2), 1)
+    dinv = np.where(d != 0.0, 1.0 / np.where(d != 0.0, d, 1.0), 0.0)
+    w = np.zeros_like(rhs)
+    r = rhs.copy()
+    z = r * dinv
+    zr = float((z * r).sum() * h1 * h2)
+    p = z.copy()
+    hist = {"zr": [], "diff": [], "alpha": [], "beta": []}
+    for _ in range(steps):
+        ap = _apply_a_np(p, a, b, h1, h2)
+        denom = float((ap * p).sum() * h1 * h2)
+        pp = float((p * p).sum())
+        if pp == 0.0:
+            break  # converged exactly; nothing more to learn
+        if denom <= 0.0:
+            return hist, (
+                f"(Ap, p) = {denom:g} on a nonzero direction — an "
+                "indefinite pivot"
+            )
+        alpha = zr / denom
+        w = w + alpha * p
+        r = r - alpha * ap
+        z = r * dinv
+        zr_new = float((z * r).sum() * h1 * h2)
+        diff = abs(alpha) * np.sqrt(pp * h1 * h2)
+        beta = zr_new / zr
+        hist["zr"].append(zr_new)
+        hist["diff"].append(diff)
+        hist["alpha"].append(alpha)
+        hist["beta"].append(beta)
+        if diff < problem.delta or zr_new == 0.0:
+            break
+        if zr_new < 0.0:
+            return hist, (
+                f"(z, r) = {zr_new:g} before convergence — the "
+                "preconditioned energy went negative"
+            )
+        zr = zr_new
+        p = z + beta * p
+    return hist, None
+
+
+def validate(problem: Problem, geometry, theta=None,
+             spd_probe: bool = True, operands=None) -> dict:
+    """Run the full admissibility gate; raise classified
+    :class:`InvalidGeometryError` on the first failure, return the
+    JSON-able acceptance report otherwise.
+
+    ``geometry`` may be an ``sdf`` shape or its JSON spec (parsed —
+    and rejected — here, the gate's first rung). ``operands`` lets a
+    caller that already assembled (a, b, rhs) f64 arrays share them;
+    ``spd_probe=False`` skips the Lanczos rung (the other operator
+    checks still run).
+    """
+    from poisson_ellipse_tpu.geom import quadrature, sdf as geom_sdf
+
+    if isinstance(geometry, dict):
+        geometry = geom_sdf.from_spec(geometry)  # raises malformed-spec
+    elif not callable(geometry):
+        _fail(
+            "malformed-spec",
+            f"geometry must be an SDF shape or its JSON spec, got "
+            f"{type(geometry).__name__}",
+        )
+    if theta is None:
+        theta = quadrature.DEFAULT_THETA
+
+    M, N = problem.M, problem.N
+    x = problem.a1 + np.arange(M + 1, dtype=np.float64) * problem.h1
+    y = problem.a2 + np.arange(N + 1, dtype=np.float64) * problem.h2
+    phi = np.asarray(geometry(x[:, None], y[None, :], np), dtype=np.float64)
+    if not np.isfinite(phi).all():
+        _fail("sdf-nonfinite", "the level set evaluates non-finite on Ω")
+    node_inside = phi < 0.0
+
+    xf, yf = _fine_points(problem, RESOLUTION_REFINE)
+    fine_inside = np.asarray(
+        geometry(xf[:, None], yf[None, :], np), dtype=np.float64
+    ) < 0.0
+    if not fine_inside.any():
+        _fail(
+            "empty-domain",
+            f"no point of Omega is inside the domain at "
+            f"{RESOLUTION_REFINE}x-refined sampling — the grid would "
+            "solve on an empty region",
+        )
+
+    # containment: the Dirichlet ring itself must not be strictly inside
+    # (tangency passes — the reference ellipse touches (+-1, 0))
+    ring_x = np.concatenate([x, x, np.full(N + 1, x[0]), np.full(N + 1, x[-1])])
+    ring_y = np.concatenate([np.full(M + 1, y[0]), np.full(M + 1, y[-1]), y, y])
+    ring_phi = np.asarray(geometry(ring_x, ring_y, np), dtype=np.float64)
+    if (ring_phi < 0.0).any():
+        _fail(
+            "boundary-contact",
+            "the domain crosses the Dirichlet ring of Omega — the "
+            "fictitious-domain penalty band needs D contained in Omega",
+        )
+
+    # resolution: every region with interior must be visible to the node
+    # lattice. A cell holding inside samples whose 1-cell-dilated corner
+    # neighborhood holds NO inside node is a feature the grid cannot see.
+    fine_cells = fine_inside.reshape(
+        M, RESOLUTION_REFINE, N, RESOLUTION_REFINE
+    ).any(axis=(1, 3))
+    cell_seen = (
+        node_inside[:-1, :-1] | node_inside[1:, :-1]
+        | node_inside[:-1, 1:] | node_inside[1:, 1:]
+    )
+    invisible = fine_cells & ~_dilate(cell_seen)
+    if invisible.any():
+        n_bad = int(invisible.sum())
+        _fail(
+            "under-resolved",
+            f"{n_bad} cell(s) contain domain interior invisible to the "
+            f"node lattice — a feature thinner than h ~ "
+            f"{max(problem.h1, problem.h2):g}; refine the grid or drop "
+            "the feature",
+        )
+    if not node_inside.any():
+        _fail(
+            "under-resolved",
+            "the domain has interior but no grid node falls inside it",
+        )
+
+    # operator checks on the f64 host assembly (rounded-once fidelity)
+    if operands is None:
+        a, b, rhs = assembly.assemble_numpy(
+            problem, geometry=geometry, theta=theta
+        )
+    else:
+        a, b, rhs = (np.asarray(o, dtype=np.float64) for o in operands)
+    if not (np.isfinite(a).all() and np.isfinite(b).all()
+            and np.isfinite(rhs).all()):
+        _fail(
+            "operator-nonfinite",
+            "assembled coefficients carry non-finite entries",
+        )
+    valid_a = a[1:M + 1, 1:N + 1]
+    valid_b = b[1:M + 1, 1:N + 1]
+    if valid_a.min() <= 0.0 or valid_b.min() <= 0.0:
+        _fail(
+            "operator-not-m-matrix",
+            "a face coefficient is <= 0 on the valid face range — the "
+            "5-point operator loses its M-matrix sign structure (and "
+            "with it the discrete maximum principle)",
+        )
+
+    rng = np.random.default_rng(0)
+    u = np.zeros_like(a)
+    v = np.zeros_like(a)
+    u[1:M, 1:N] = rng.standard_normal((M - 1, N - 1))
+    v[1:M, 1:N] = rng.standard_normal((M - 1, N - 1))
+    au = _apply_a_np(u, a, b, problem.h1, problem.h2)
+    av = _apply_a_np(v, a, b, problem.h1, problem.h2)
+    uv_scale = max(abs(float((au * u).sum())), abs(float((av * v).sum())),
+                   1e-30)
+    asym = abs(float((au * v).sum()) - float((u * av).sum()))
+    if asym > _SYMMETRY_RTOL * uv_scale:
+        _fail(
+            "operator-asymmetric",
+            f"<Au, v> != <u, Av> (relative defect {asym / uv_scale:.2e})",
+        )
+
+    report: dict = {
+        "ok": True,
+        "theta": theta,
+        "inside_nodes": int(node_inside.sum()),
+        "checks": [
+            "spec", "sdf-finite", "non-empty", "containment",
+            "resolution", "operator-finite", "m-matrix", "symmetry",
+        ],
+    }
+    if spd_probe:
+        from poisson_ellipse_tpu.obs import spectrum
+
+        steps = min(LANCZOS_STEPS, max((M - 1) * (N - 1), 1))
+        hist, witness = _lanczos_probe(problem, a, b, rhs, steps)
+        if witness is not None:
+            _fail("operator-not-spd", f"Lanczos probe: {witness}")
+        trace = {k: np.asarray(vv, dtype=np.float64)
+                 for k, vv in hist.items()}
+        ritz = spectrum.ritz_values(trace)
+        if ritz.size and float(ritz[0]) <= 0.0:
+            _fail(
+                "operator-not-spd",
+                f"non-positive Ritz value {float(ritz[0]):g} — the "
+                "preconditioned operator is not SPD",
+            )
+        report["checks"].append("spd-lanczos")
+        report["lanczos_steps"] = int(
+            np.asarray(trace["alpha"]).size
+        )
+        bounds = spectrum.eigenvalue_bounds(trace)
+        if bounds is not None:
+            report["ritz_interval"] = [bounds[0], bounds[1]]
+    return report

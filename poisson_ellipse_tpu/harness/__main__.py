@@ -66,7 +66,10 @@ And the resilience surface:
   convergence, 2 diverged (breakdown / recovery exhausted; also invalid
   invocations, per argparse convention), 3 device out-of-memory with no
   engine left to degrade to, 4 ``--timeout`` exceeded, 5 shed at
-  admission by the serving layer (backpressure; retry after the hint).
+  admission by the serving layer (backpressure; retry after the hint),
+  8 geometry rejected by the admissibility gate (``--geometry`` with a
+  malformed/empty/under-resolved spec or an inadmissible operator —
+  classified before any device dispatch).
 """
 
 from __future__ import annotations
@@ -98,7 +101,10 @@ EXIT_CODES_HELP = (
     "the serving layer (backpressure — resubmit after retry_after_s); "
     "6 silent data corruption detected by the ABFT checks and not "
     "cleared by rollback-and-rerun (persistent SDC source); 7 mesh "
-    "device lost with no degraded mesh left to resume on."
+    "device lost with no degraded mesh left to resume on; 8 geometry "
+    "rejected by the admissibility gate (malformed/empty/under-resolved "
+    "spec or inadmissible operator — classified BEFORE any device "
+    "dispatch)."
 )
 
 
@@ -992,6 +998,27 @@ def main(argv=None) -> int:
         "--norm", choices=("weighted", "unweighted"), default="weighted"
     )
     ap.add_argument("--max-iter", type=int, default=None)
+    ap.add_argument(
+        "--geometry",
+        metavar="SPEC",
+        help="solve on an arbitrary SDF domain: a path to a JSON "
+        "geometry spec file, or the inline JSON itself (geom.sdf "
+        "primitives + union/intersection/difference/translate). The "
+        "admissibility gate (geom.validate) runs before any device "
+        "dispatch — a bad spec is the classified exit 8, never a hung "
+        "solve. The default (no flag) is the closed-form ellipse, "
+        "bit-identical to previous releases",
+    )
+    ap.add_argument(
+        "--theta",
+        type=float,
+        default=None,
+        metavar="FRAC",
+        help="degenerate-cut clamp threshold for --geometry: face "
+        "fractions within theta of empty/full snap to empty/full, each "
+        "clamp reported as a geom:degenerate-cut trace event (default: "
+        "geom.quadrature.DEFAULT_THETA; 0 disables the defense)",
+    )
     ap.add_argument("--repeat", type=int, default=1, help="timing repetitions")
     ap.add_argument(
         "--batch",
@@ -1140,6 +1167,28 @@ def main(argv=None) -> int:
             obs_trace.stop()
 
 
+def _geometry_spec(arg: str | None):
+    """The --geometry value as a parsed JSON object: a file path or the
+    inline JSON itself. An unreadable path is an invocation error
+    (exit 2); unparseable JSON is a *content* defect and classifies as
+    the gate's ``malformed-spec`` (exit 8) like every other bad spec."""
+    if arg is None:
+        return None
+    from poisson_ellipse_tpu.resilience.errors import InvalidGeometryError
+
+    text = arg
+    if not arg.lstrip().startswith("{"):
+        with open(arg, "r", encoding="utf-8") as fh:
+            text = fh.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError as e:
+        raise InvalidGeometryError(
+            f"malformed geometry spec: not valid JSON ({e})",
+            reason="malformed-spec",
+        ) from e
+
+
 def _run_cli(args) -> int:
     """The measured-run body of ``main`` (post-parse, post-trace-setup)."""
     eps_values = (
@@ -1147,6 +1196,17 @@ def _run_cli(args) -> int:
         if args.eps_sweep
         else [args.eps]
     )
+    try:
+        geometry = _geometry_spec(args.geometry)
+    except OSError as e:
+        print(f"error: cannot read --geometry: {e}", file=sys.stderr)
+        return 2
+    except SolveError as e:
+        print(f"error: {e.classification}: {e}", file=sys.stderr)
+        return e.exit_code
+    if args.geometry is None and args.theta is not None:
+        print("error: --theta needs --geometry", file=sys.stderr)
+        return 2
 
     if args.threads_sweep:
         if args.mode != "native":
@@ -1241,6 +1301,8 @@ def _run_cli(args) -> int:
                         timeout=args.timeout,
                         guard=args.guard,
                         max_recoveries=args.max_recoveries,
+                        geometry=geometry,
+                        theta=args.theta,
                     )
             except SolveError as e:
                 # the classified exit contract: the trace keeps every

@@ -11,6 +11,7 @@ no stage computes it — SURVEY §4.1).
 
 from __future__ import annotations
 
+import math
 import statistics
 import time
 from dataclasses import dataclass, field
@@ -207,7 +208,12 @@ class RunReport:
             "iters": self.iters,
             "converged": self.converged,
             "diff": self.diff,
-            "l2_error": self.l2_error,
+            # NaN (the --geometry runs' "analytic metric undefined")
+            # must serialize as null: a literal NaN token is not RFC
+            # JSON and strict consumers reject the whole record
+            "l2_error": (
+                self.l2_error if math.isfinite(self.l2_error) else None
+            ),
             "t_init_s": self.t_init,
             "t_solver_s": self.t_solver,
             "passes_per_iter": self.passes_per_iter,
@@ -242,8 +248,18 @@ def run_once(
     timeout: float | None = None,
     guard: bool = False,
     max_recoveries: int = 3,
+    geometry=None,
+    theta: float | None = None,
 ) -> RunReport:
     """Assemble + solve with fenced init/solver timing.
+
+    ``geometry`` (a ``geom.sdf`` shape or its JSON spec) selects an
+    arbitrary SDF domain: the admissibility gate runs before any build
+    (classified ``InvalidGeometryError``, exit 8 in the CLI), operands
+    come from the bisection quadrature with the degenerate-cut clamp at
+    ``theta``, and — since the analytic solution is an ellipse fact —
+    the report's ``l2_error`` is NaN (convergence + the maximum
+    principle are the checks for arbitrary domains).
 
     mode:  "single" — single-device solver (stage0/1/4-1GPU analog);
            "sharded" — mesh-sharded solver (stage2/3/4 analog);
@@ -282,6 +298,16 @@ def run_once(
     """
     if lanes < 1:
         raise ValueError("lanes must be >= 1")
+    if geometry is not None and mode == "native":
+        raise ValueError(
+            "--geometry rides the JAX assembly paths; the native host "
+            "runtime implements the closed-form ellipse only"
+        )
+    if geometry is not None and checkpoint_dir is not None:
+        raise ValueError(
+            "checkpoint fingerprints do not cover a geometry spec yet; "
+            "drop --checkpoint-dir or --geometry"
+        )
     if lanes > 1 or engine in BATCHED_ENGINES:
         if mode == "native":
             raise ValueError(
@@ -325,6 +351,22 @@ def run_once(
         )
     if mode not in ("single", "sharded"):
         raise ValueError(f"unknown mode: {mode!r}")
+    if geometry is not None:
+        # the gate runs ONCE here for every JAX path (the sharded
+        # builders assemble without re-validating, and build_solver is
+        # told the gate already passed)
+        from poisson_ellipse_tpu.geom import sdf as geom_sdf
+        from poisson_ellipse_tpu.geom import validate as geom_validate
+
+        if isinstance(geometry, dict):
+            geometry = geom_sdf.from_spec(geometry)
+        geom_validate.validate(problem, geometry, theta=theta)
+        if mode == "sharded" and engine in BATCHED_ENGINES:
+            raise ValueError(
+                "lane-sharded batched runs take per-request geometry "
+                "through the serve scheduler; drop --geometry or use a "
+                "single-solve engine"
+            )
     if timeout is not None or guard:
         if checkpoint_dir is not None:
             raise ValueError(
@@ -342,12 +384,19 @@ def run_once(
                     "guarded batched solves run the single-device chunked "
                     "lane driver (batch.driver); drop --mesh/--mode sharded"
                 )
+            if geometry is not None:
+                raise ValueError(
+                    "guarded lane-batched runs take per-request geometry "
+                    "through the serve scheduler; drop --geometry or "
+                    "--guard/--lanes"
+                )
             return _run_batched_guarded(
                 problem, dtype, jdtype, engine, lanes, timeout=timeout,
             )
         return _run_guarded(
             problem, mode, mesh_shape, dtype, jdtype, engine,
             timeout=timeout, max_recoveries=max_recoveries,
+            geometry=geometry, theta=theta,
         )
     if checkpoint_dir is not None:
         if repeat > 1 or batch > 1:
@@ -366,7 +415,8 @@ def run_once(
     if mode == "single":
         with timer.phase("init"):
             solver, args, engine = build_solver(
-                problem, engine, jdtype, lanes=lanes
+                problem, engine, jdtype, lanes=lanes, geometry=geometry,
+                theta=theta, validate_geometry=False,
             )
             fence(args)
         shape = (1, 1)
@@ -394,6 +444,7 @@ def run_once(
             solver, args = build_mg_sharded_solver(
                 problem, mesh, jdtype,
                 kind=PRECOND_KIND_BY_ENGINE[engine],
+                geometry=geometry, theta=theta,
             )
             fence(args)
         shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
@@ -413,7 +464,8 @@ def run_once(
         with timer.phase("init"):
             mesh = resolve_mesh(mesh_shape)
             solver, args = build_sharded_solver(
-                problem, mesh, jdtype, stencil_impl=engine
+                problem, mesh, jdtype, stencil_impl=engine,
+                geometry=geometry, theta=theta,
             )
             fence(args)
         shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
@@ -427,7 +479,8 @@ def run_once(
     # allocator is the judge.
     if mode == "single":
         solver, args, engine, result = _warm_with_degradation(
-            problem, jdtype, solver, args, engine, auto=requested_auto
+            problem, jdtype, solver, args, engine, auto=requested_auto,
+            geometry=geometry, theta=theta,
         )
     else:
         result = solver(*args)
@@ -482,12 +535,12 @@ def run_once(
 
     return _finish_report(
         problem, shape, dtype, jdtype, engine, result, timer, times,
-        lanes=lanes,
+        lanes=lanes, analytic=geometry is None,
     )
 
 
 def _warm_with_degradation(problem, jdtype, solver, args, engine: str,
-                           auto: bool):
+                           auto: bool, geometry=None, theta=None):
     """The first (compile + warm-up) dispatch, with the runtime OOM
     ladder for auto-selected engines.
 
@@ -526,8 +579,11 @@ def _warm_with_degradation(problem, jdtype, solver, args, engine: str,
             time.sleep(_DEGRADE_BACKOFF_S)
             # the rebuild IS the degradation ladder: one build per OOM
             # rung, bounded by the ladder length
-            # tpulint: disable=TPU013
-            solver, args, engine = build_solver(problem, nxt, jdtype)
+            solver, args, engine = build_solver(
+                # tpulint: disable=TPU013 — one build per OOM rung
+                problem, nxt, jdtype, geometry=geometry, theta=theta,
+                validate_geometry=False,
+            )
 
 
 def _run_guarded(
@@ -539,6 +595,8 @@ def _run_guarded(
     engine: str,
     timeout: float | None,
     max_recoveries: int,
+    geometry=None,
+    theta=None,
 ) -> RunReport:
     """One guarded (and/or deadlined) solve through
     ``resilience.guard.guarded_solve``. Timing is a plain wall clock
@@ -558,14 +616,14 @@ def _run_guarded(
     t0 = time.perf_counter()
     guarded = guarded_solve(
         problem, engine, jdtype, mesh=mesh, timeout=timeout,
-        max_recoveries=max_recoveries,
+        max_recoveries=max_recoveries, geometry=geometry, theta=theta,
     )
     fence(guarded.result)
     t_solve = time.perf_counter() - t0
     timer.add("solver", t_solve)
     report = _finish_report(
         problem, shape, dtype, jdtype, guarded.engine, guarded.result,
-        timer, [t_solve],
+        timer, [t_solve], analytic=geometry is None,
     )
     report.recoveries = [event.kind for event in guarded.recoveries]
     return report
@@ -643,6 +701,7 @@ def _finish_report(
     timed_iters: int | None = None,
     lanes: int = 1,
     quarantined: int = 0,
+    analytic: bool = True,
 ) -> RunReport:
     """Shared report tail: L2-vs-analytic, roofline, RunReport assembly.
 
@@ -673,7 +732,13 @@ def _finish_report(
         diff = float(result.diff)
         w0 = result.w
     with timer.phase("finalize"):
-        l2 = float(l2_error_vs_analytic(problem, w0))
+        # the analytic solution is an ellipse fact; for an arbitrary SDF
+        # domain the metric is undefined — reported NaN, never a number
+        # that silently compares a different domain's solution to it
+        l2 = (
+            float(l2_error_vs_analytic(problem, w0)) if analytic
+            else float("nan")
+        )
 
     from poisson_ellipse_tpu.harness.roofline import roofline
 

@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU014.
+"""The tpulint rule registry: TPU001–TPU015.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -50,6 +50,14 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | cap in sight — the hot-spin retry storm that  |
 |        |                    | turns one failing dispatch into a pegged host |
 |        |                    | and a hammered runtime                        |
+| TPU015 | host-roundtrip     | `float()`/`int()`/`bool()`/`.item()` on a     |
+|        |                    | value derived from the array parameters of a  |
+|        |                    | traced function or an `xp=`-dual geometry     |
+|        |                    | function — a host round-trip that breaks the  |
+|        |                    | traced path (ConcretizationTypeError on jit)  |
+|        |                    | and silently downcasts the host-f64 one;      |
+|        |                    | validation runs on host arrays, the traced    |
+|        |                    | path stays pure                               |
 """
 
 from __future__ import annotations
@@ -1915,3 +1923,118 @@ def check_retry_without_backoff(module: Module, config: LintConfig) -> Iterator[
             "suppress with a note when the retry consumes a finite "
             "worklist",
         )
+
+
+# --------------------------------------------------------------------------
+# TPU015 — host round-trips on traced / xp-dual geometry values
+# --------------------------------------------------------------------------
+
+_ROUNDTRIP_CALLS = frozenset({"float", "int", "bool"})
+_ROUNDTRIP_METHODS = frozenset({"item", "tolist"})
+
+
+def _xp_dual_fns(module: Module) -> Iterator[TracedFn]:
+    """Functions following the repo's ``xp=`` array-module convention
+    (``models.ellipse`` / ``geom.sdf``): one body serving BOTH the
+    host-f64 numpy path and the traced jnp path. Their array parameters
+    get the same taint treatment as a jitted function's — a host
+    round-trip in one breaks the traced half of the contract."""
+    for fn in module.functions.values():
+        a = fn.args
+        names = [p.arg for p in getattr(a, "posonlyargs", [])]
+        names += [p.arg for p in a.args] + [p.arg for p in a.kwonlyargs]
+        if "xp" not in names:
+            continue
+        # xp itself (and self) are module/instance handles, not data;
+        # default-valued parameters are config scalars (samples=16), not
+        # the coordinate arrays the dual-path contract is about
+        static = {"xp", "self"}
+        pos = [p.arg for p in getattr(a, "posonlyargs", [])] + [
+            p.arg for p in a.args
+        ]
+        if a.defaults:
+            static.update(pos[len(pos) - len(a.defaults):])
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                static.add(p.arg)
+        yield TracedFn(fn, "xp-dual", frozenset(static))
+
+
+@rule(
+    "TPU015",
+    "host-roundtrip",
+    "float()/int()/bool()/.item() on a value derived from a traced or "
+    "xp-dual function's array parameters — a host round-trip where the "
+    "computation must stay pure",
+)
+def check_host_roundtrip(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """The geometry-purity fence. Admissibility validation runs on HOST
+    float64 arrays by contract (``geom.validate``), and the traced
+    assembly/solve path must stay pure — so any ``float(x)`` /
+    ``int(x)`` / ``bool(x)`` / ``x.item()`` / ``x.tolist()`` applied to
+    a value derived from the array parameters of a *traced* function
+    (jit-decorated, jit-wrapped, or a lax loop body) or of an
+    ``xp=``-dual geometry function is a bug by construction: under jit
+    it raises ``ConcretizationTypeError`` at best (and forces a silent
+    device sync at worst), and on the host path it silently collapses
+    an f64 array fact into one Python scalar.
+
+    Conservative by the registry's standing rules: only direct calls on
+    expressions whose taint is established by the shallow forward taint
+    of ``Module.tainted_names`` — static facts (``x.shape``,
+    ``len(x)``) never taint, and untraced host drivers (the guard's
+    chunk loop, the harness) are out of scope. Lax loop BODIES are
+    TPU008's domain (one defect, one code): this rule keeps the
+    jit-def/jit-call surface and the xp-dual geometry functions.
+    """
+    fns = [f for f in module.traced_fns if f.kind != "loop-body"]
+    fns += list(_xp_dual_fns(module))
+    seen_nodes: set[int] = set()
+    for fn in fns:
+        if id(fn.node) in seen_nodes:
+            continue
+        seen_nodes.add(id(fn.node))
+        tainted = module.tainted_names(fn)
+        if not tainted:
+            continue
+        body = fn.node.body if isinstance(fn.node.body, list) else [fn.node.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _ROUNDTRIP_CALLS
+                    and len(node.args) == 1
+                    and module.expr_mentions(node.args[0], tainted)
+                ):
+                    name = getattr(fn.node, "name", "<lambda>")
+                    yield _finding(
+                        module,
+                        node,
+                        "TPU015",
+                        f"`{node.func.id}(...)` on a value derived from "
+                        f"the array parameters of `{name}` — a host "
+                        "round-trip inside a traced/xp-dual computation. "
+                        "Keep the computation in array ops; do host "
+                        "conversions in the (untraced) caller on host "
+                        "arrays",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ROUNDTRIP_METHODS
+                    and not node.args
+                    and module.expr_mentions(node.func.value, tainted)
+                ):
+                    name = getattr(fn.node, "name", "<lambda>")
+                    yield _finding(
+                        module,
+                        node,
+                        "TPU015",
+                        f"`.{node.func.attr}()` on a value derived from "
+                        f"the array parameters of `{name}` — a host "
+                        "round-trip inside a traced/xp-dual computation. "
+                        "Keep the computation in array ops; do host "
+                        "conversions in the (untraced) caller on host "
+                        "arrays",
+                    )

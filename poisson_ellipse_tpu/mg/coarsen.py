@@ -128,10 +128,19 @@ class Level:
         return (self.M + 1, self.N + 1)
 
 
-def coefficient_hierarchy(problem: Problem) -> list[dict]:
+def coefficient_hierarchy(problem: Problem, geometry=None,
+                          theta=None) -> list[dict]:
     """Host-f64 (a, b) per level, finest first — the shared source both
-    the single-chip and the mg-sharded builders cast/lay out from."""
-    a, b, _ = assembly.assemble_numpy(problem)
+    the single-chip and the mg-sharded builders cast/lay out from.
+
+    ``geometry``/``theta`` select the SDF quadrature assembly for the
+    finest level (``ops.assembly``); the coarsening law is untouched —
+    harmonic-then-arithmetic preserves strict positivity for ANY
+    positive fine coefficients, so every coarse operator stays a
+    5-point SPD M-matrix under composite SDFs exactly as under the
+    closed-form ellipse (pinned in ``tests/test_geom.py``)."""
+    a, b, _ = assembly.assemble_numpy(problem, geometry=geometry,
+                                      theta=theta)
     levels = num_levels(problem.M, problem.N)
     out = [{
         "M": problem.M, "N": problem.N,
@@ -148,7 +157,8 @@ def coefficient_hierarchy(problem: Problem) -> list[dict]:
     return out
 
 
-def build_hierarchy(problem: Problem, dtype=jnp.float32) -> list[Level]:
+def build_hierarchy(problem: Problem, dtype=jnp.float32, geometry=None,
+                    theta=None) -> list[Level]:
     """The device-resident level list (finest first) for one chip.
 
     Coefficients are coarsened on the host in f64 and cast once; the
@@ -157,7 +167,7 @@ def build_hierarchy(problem: Problem, dtype=jnp.float32) -> list[Level]:
     """
     np_dtype = assembly.numpy_dtype(dtype)
     out = []
-    for lv in coefficient_hierarchy(problem):
+    for lv in coefficient_hierarchy(problem, geometry=geometry, theta=theta):
         a = jnp.asarray(lv["a"].astype(np_dtype))
         b = jnp.asarray(lv["b"].astype(np_dtype))
         h1 = jnp.asarray(lv["h1"], dtype)

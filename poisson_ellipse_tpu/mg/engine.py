@@ -153,7 +153,8 @@ def _level_ops(levels: list[coarsen.Level], cfg: PrecondConfig,
 
 
 def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
-                 config: PrecondConfig | None = None, operands=None):
+                 config: PrecondConfig | None = None, operands=None,
+                 geometry=None, theta=None):
     """(precond_factory, config): the engine-facing build.
 
     ``precond_factory(a, b) -> (r -> M⁻¹ r)`` is called INSIDE the
@@ -168,7 +169,8 @@ def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
     """
     a, b, rhs = (
         operands if operands is not None
-        else assembly.assemble(problem, dtype)
+        else assembly.assemble(problem, dtype, geometry=geometry,
+                               theta=theta)
     )
     cfg = config if config is not None else resolve_config(
         problem, a, b, rhs, kind
@@ -179,7 +181,9 @@ def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
     if cfg.kind == "cheb":
         hier = None
     else:
-        hier = coarsen.build_hierarchy(problem, dtype)[: cfg.levels]
+        hier = coarsen.build_hierarchy(
+            problem, dtype, geometry=geometry, theta=theta
+        )[: cfg.levels]
 
     def factory(fine_a, fine_b):
         if cfg.kind == "cheb":
@@ -201,17 +205,20 @@ def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
 
 
 def build_precond_solver(problem: Problem, engine: str, dtype=jnp.float32,
-                         history: bool = False):
+                         history: bool = False, geometry=None, theta=None):
     """(jitted solver, args, resolved engine) — the ``solver.engine``
     branch for ``mg-pcg`` / ``cheb-pcg``. Same contract as every other
     engine: args = the assembled (a, b, rhs), one fused while_loop, the
-    ``PCGResult`` (+ optional ``ConvergenceTrace``) out."""
+    ``PCGResult`` (+ optional ``ConvergenceTrace``) out. ``geometry``/
+    ``theta`` flow into the fine assembly AND the coarsening hierarchy
+    (``mg.coarsen``) so every level sees the same domain."""
     from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
 
-    a, b, rhs = assembly.assemble(problem, dtype)
+    a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
+                                  theta=theta)
     factory, _cfg = make_precond(
         problem, dtype, PRECOND_KIND_BY_ENGINE[engine],
-        operands=(a, b, rhs),
+        operands=(a, b, rhs), geometry=geometry, theta=theta,
     )
 
     # no donation: the build-once-call-many contract re-feeds these

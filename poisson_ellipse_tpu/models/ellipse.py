@@ -19,6 +19,20 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def safe_sqrt(v, xp=jnp):
+    """sqrt clamped at zero with a reverse-mode-safe zero branch.
+
+    ``sqrt(maximum(0, v))`` is value-correct but its cotangent at exactly
+    v = 0 is 1/(2·sqrt(0)) = inf, which ``jax.grad`` propagates as NaN —
+    the classic ``where``-free sqrt hazard. Clamping *inside* a ``where``
+    on both branches keeps the primal identical and pins the gradient to
+    0 on the clamped branch, so differentiable solves (ROADMAP item 3's
+    shape-optimisation workload) can differentiate through the geometry.
+    """
+    positive = v > 0.0
+    return xp.where(positive, xp.sqrt(xp.where(positive, v, 1.0)), 0.0)
+
+
 def is_in_d(x, y):
     """Membership mask of the open ellipse x² + 4y² < 1.
 
@@ -42,7 +56,7 @@ def segment_length_vertical(x0, y_start, y_end, xp=jnp):
     Closed form: for |x0| < 1 the ellipse spans |y| ≤ sqrt((1-x0²)/4).
     Reference: ``stage0/Withoutopenmp1.cpp:21-28`` (is_ver branch).
     """
-    y_max = xp.sqrt(xp.maximum(0.0, (1.0 - x0 * x0) / 4.0))
+    y_max = safe_sqrt((1.0 - x0 * x0) / 4.0, xp)
     length = xp.maximum(
         0.0, xp.minimum(y_end, y_max) - xp.maximum(y_start, -y_max)
     )
@@ -55,7 +69,7 @@ def segment_length_horizontal(y0, x_start, x_end, xp=jnp):
     Closed form: for |2·y0| < 1 the ellipse spans |x| ≤ sqrt(1-4y0²).
     Reference: ``stage0/Withoutopenmp1.cpp:29-37`` (horizontal branch).
     """
-    x_max = xp.sqrt(xp.maximum(0.0, 1.0 - 4.0 * y0 * y0))
+    x_max = safe_sqrt(1.0 - 4.0 * y0 * y0, xp)
     length = xp.maximum(
         0.0, xp.minimum(x_end, x_max) - xp.maximum(x_start, -x_max)
     )

@@ -114,7 +114,7 @@ def interior_mask(problem: Problem, gi, gj):
     )
 
 
-def assemble_numpy(problem: Problem):
+def assemble_numpy(problem: Problem, geometry=None, theta=None):
     """Full-precision host assembly in vectorised numpy float64.
 
     The geometry MUST be evaluated in f64 regardless of the solve dtype:
@@ -126,6 +126,15 @@ def assemble_numpy(problem: Problem):
     target dtype's resolution. This mirrors the reference, which always
     assembles on the host in double (``poisson_mpi_cuda2.cu:146-192``).
 
+    ``geometry=None`` (the default) is the hard-coded ellipse through
+    its closed forms — BIT-identical to every pre-geometry release.
+    A ``geom.sdf`` shape switches the face lengths to the adaptive
+    bisection quadrature (``geom.quadrature``) and the RHS indicator to
+    the SDF sign, with the degenerate-cut clamp at threshold ``theta``
+    (default ``geom.quadrature.DEFAULT_THETA``; 0 disables). Every
+    clamped face is REPORTED as one ``geom:degenerate-cut`` trace event
+    carrying the counts — the defense is observable, never silent.
+
     Public API: the sharded solver pads/casts/lays these arrays out over
     the mesh. Uses the same closed forms as the traced path via
     ``_coefficients_xp(…, xp=numpy)``.
@@ -135,13 +144,16 @@ def assemble_numpy(problem: Problem):
     gj = np.arange(N + 1, dtype=np.float64)
     x = problem.a1 + gi * problem.h1
     y = problem.a2 + gj * problem.h2
-    a, b = _coefficients_xp(problem, x, y, np)
+    if geometry is None:
+        a, b = _coefficients_xp(problem, x, y, np)
+        inside = ellipse.is_in_d(x[:, None], y[None, :])
+    else:
+        a, b, inside = _geometry_coefficients(problem, geometry, theta, x, y)
 
     valid = ((gi >= 1) & (gi <= M))[:, None] & ((gj >= 1) & (gj <= N))[None, :]
     a = np.where(valid, a, 0.0)
     b = np.where(valid, b, 0.0)
 
-    inside = ellipse.is_in_d(x[:, None], y[None, :])
     interior = ((gi >= 1) & (gi <= M - 1))[:, None] & (
         (gj >= 1) & (gj <= N - 1)
     )[None, :]
@@ -149,7 +161,64 @@ def assemble_numpy(problem: Problem):
     return a, b, rhs
 
 
-def assemble(problem: Problem, dtype=jnp.float32):
+# memo over the quadrature assembly, keyed (problem, geometry, theta):
+# one geometry-threaded BUILD legitimately assembles 2-3 times (the
+# operand set, the mg hierarchy's finest level, a validation pass), and
+# the bisection sweep is the expensive host step — pay it once per
+# distinct key, and emit the geom:degenerate-cut event once per
+# distinct assembly rather than once per call. SDF shapes are frozen
+# dataclasses (hashable); an unhashable custom shape just skips the
+# memo. Entries are f64 read-backs — copies go out, so a caller
+# mutating its arrays cannot poison later builds.
+_GEOM_MEMO: dict = {}
+_GEOM_MEMO_MAX = 8
+
+
+def _geometry_coefficients(problem: Problem, geometry, theta, x, y):
+    """The SDF-general twin of ``_coefficients_xp``: face lengths by
+    bisection quadrature, the degenerate-cut clamp, the same blend law.
+    Host f64 only (the traced path stays closed-form ellipse)."""
+    from poisson_ellipse_tpu.geom import quadrature, sdf as geom_sdf
+    from poisson_ellipse_tpu.obs import trace as obs_trace
+
+    if theta is None:
+        theta = quadrature.DEFAULT_THETA
+    try:
+        key = (problem, geometry, float(theta))
+        hash(key)
+    except TypeError:
+        key = None
+    if key is not None and key in _GEOM_MEMO:
+        a, b, inside = _GEOM_MEMO[key]
+        return a.copy(), b.copy(), inside.copy()
+    la, lb = quadrature.segment_lengths(problem, geometry)
+    la, a_empty, a_full = quadrature.clamp_lengths(la, problem.h2, theta)
+    lb, b_empty, b_full = quadrature.clamp_lengths(lb, problem.h1, theta)
+    clamped = a_empty + a_full + b_empty + b_full
+    if clamped:
+        obs_trace.event(
+            "geom:degenerate-cut",
+            theta=theta,
+            clamped=clamped,
+            to_empty=a_empty + b_empty,
+            to_full=a_full + b_full,
+            grid=[problem.M, problem.N],
+        )
+    eps = problem.eps_value
+    a = _blend(la, problem.h2, eps, np)
+    b = _blend(lb, problem.h1, eps, np)
+    inside = np.asarray(
+        geom_sdf.is_inside(geometry, x[:, None], y[None, :], np)
+    )
+    if key is not None:
+        if len(_GEOM_MEMO) >= _GEOM_MEMO_MAX:
+            _GEOM_MEMO.pop(next(iter(_GEOM_MEMO)))
+        _GEOM_MEMO[key] = (a, b, inside)
+        return a.copy(), b.copy(), inside.copy()
+    return a, b, inside
+
+
+def assemble(problem: Problem, dtype=jnp.float32, geometry=None, theta=None):
     """Assemble the full global (a, b, rhs) node-grid arrays, shape (M+1, N+1).
 
     Geometry is evaluated on the host in float64 (see ``assemble_numpy``
@@ -157,9 +226,11 @@ def assemble(problem: Problem, dtype=jnp.float32):
     exactly as the reference assembles on the CPU host before uploading
     (``poisson_mpi_cuda2.cu:716-759``). Row/col 0 of a,b are zero, matching
     the reference's (M+1)×(N+1) zero-initialised vectors
-    (``stage0/Withoutopenmp1.cpp:111-112``).
+    (``stage0/Withoutopenmp1.cpp:111-112``). ``geometry``/``theta``
+    select the SDF quadrature path (see ``assemble_numpy``); None keeps
+    the closed-form ellipse bit-identical to before.
     """
-    a, b, rhs = assemble_numpy(problem)
+    a, b, rhs = assemble_numpy(problem, geometry=geometry, theta=theta)
     return (
         jnp.asarray(a.astype(numpy_dtype(dtype))),
         jnp.asarray(b.astype(numpy_dtype(dtype))),

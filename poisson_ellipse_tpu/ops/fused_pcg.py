@@ -349,12 +349,15 @@ def interior_normalized(problem: Problem, a, b):
     return an, as_, bw, be, d, dinv
 
 
-def fused_operands(problem: Problem, g1p: int, g2p: int, dtype):
+def fused_operands(problem: Problem, g1p: int, g2p: int, dtype,
+                   geometry=None, theta=None):
     """Device-ready loop-invariant operands, rounded once from the f64
-    host assembly (the oracle-exact path; see normalized_coefficients)."""
+    host assembly (the oracle-exact path; see normalized_coefficients).
+    ``geometry``/``theta`` select the SDF quadrature assembly."""
     import numpy as np
 
-    a64, b64, _ = assembly.assemble_numpy(problem)
+    a64, b64, _ = assembly.assemble_numpy(problem, geometry=geometry,
+                                          theta=theta)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     return normalized_coefficients(problem, a64, b64, g1p, g2p, np_dtype)
 
@@ -492,7 +495,7 @@ def pcg_fused(problem: Problem, a, b, rhs, interpret=None,
 
 
 def build_fused_solver(problem: Problem, dtype=jnp.float32, interpret=None,
-                       history: bool = False):
+                       history: bool = False, geometry=None, theta=None):
     """(jitted solver, args) with the f64-rounded operand set.
 
     The operands (normalised coefficients + RHS) are assembled on the
@@ -507,8 +510,10 @@ def build_fused_solver(problem: Problem, dtype=jnp.float32, interpret=None,
         raise ValueError("fused solver supports f32/bf16; use stencil='xla'")
     g1, g2 = problem.node_shape
     kern = build_kernels(problem, g1, g2, dtype, interpret=interpret)
-    coeffs = fused_operands(problem, kern.g1p, kern.g2p, dtype)
-    _, _, rhs64 = assembly.assemble_numpy(problem)
+    coeffs = fused_operands(problem, kern.g1p, kern.g2p, dtype,
+                            geometry=geometry, theta=theta)
+    _, _, rhs64 = assembly.assemble_numpy(problem, geometry=geometry,
+                                          theta=theta)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     r0 = jnp.asarray(
         np.pad(

@@ -192,7 +192,7 @@ def _mega_kernel(h1, h2, delta, weighted, max_iter,
 
 
 def build_resident_solver(problem: Problem, dtype=jnp.float32,
-                          interpret=None):
+                          interpret=None, geometry=None, theta=None):
     """(jitted whole-solve kernel, args) for a grid that fits VMEM.
 
     args are the f64-rounded normalised operands + RHS (the same operand
@@ -214,8 +214,10 @@ def build_resident_solver(problem: Problem, dtype=jnp.float32,
     g1, g2 = problem.node_shape
     g1p, g2p = padded_shape(problem)
 
-    coeffs = fused_operands(problem, g1p, g2p, dtype)
-    _, _, rhs64 = assembly.assemble_numpy(problem)
+    coeffs = fused_operands(problem, g1p, g2p, dtype, geometry=geometry,
+                            theta=theta)
+    _, _, rhs64 = assembly.assemble_numpy(problem, geometry=geometry,
+                                          theta=theta)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     r0 = jnp.asarray(
         np.pad(rhs64, ((0, g1p - g1), (0, g2p - g2))).astype(np_dtype)

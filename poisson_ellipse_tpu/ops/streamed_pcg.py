@@ -526,7 +526,8 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
     flags_out[1] = out[5].astype(jnp.int32)
 
 
-def streamed_operand_set(problem: Problem, dtype, g1p: int, g2p: int):
+def streamed_operand_set(problem: Problem, dtype, g1p: int, g2p: int,
+                         geometry=None, theta=None):
     """(dinv, an, bw, r0): f64-assembled, rounded once, zero-padded to
     (g1p, g2p) — the operand fidelity contract shared by the streamed
     and xl engines (one copy; see ``fused_pcg.build_fused_solver``).
@@ -546,7 +547,8 @@ def streamed_operand_set(problem: Problem, dtype, g1p: int, g2p: int):
     )
 
     np_dtype = np.dtype(jnp.dtype(dtype).name)
-    a64, b64, rhs64 = assembly.assemble_numpy(problem)
+    a64, b64, rhs64 = assembly.assemble_numpy(problem, geometry=geometry,
+                                              theta=theta)
     dinv64 = interior_normalized(problem, a64, b64)[5]
     anu64, bwu64 = normalized_unmasked(problem, a64, b64)
 
@@ -561,7 +563,8 @@ def streamed_operand_set(problem: Problem, dtype, g1p: int, g2p: int):
 
 
 def build_streamed_solver(problem: Problem, dtype=jnp.float32,
-                          interpret=None, tm: int | None = None):
+                          interpret=None, tm: int | None = None,
+                          geometry=None, theta=None):
     """(jitted whole-solve kernel, args) for large grids.
 
     args = (dinv, a, b, r0), all f64-assembled and rounded once (same
@@ -582,7 +585,8 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
             "sharded solver"
         )
     g1p, g2p, tm = plan.g1p, plan.g2p, plan.tm
-    args = streamed_operand_set(problem, dtype, g1p, g2p)
+    args = streamed_operand_set(problem, dtype, g1p, g2p,
+                                geometry=geometry, theta=theta)
 
     kernel = functools.partial(
         _mega_kernel, problem, plan, problem.norm == "weighted"

@@ -399,7 +399,8 @@ def _mega_kernel(problem: Problem, plan: XLPlan, weighted: bool,
 
 
 def build_xl_solver(problem: Problem, dtype=jnp.float32, interpret=None,
-                    tm: int | None = None, _debug_raw: bool = False):
+                    tm: int | None = None, _debug_raw: bool = False,
+                    geometry=None, theta=None):
     """(jitted whole-solve kernel, args) for state-beyond-VMEM grids.
 
     args = (dinv, a, b, r0): f64-assembled, rounded once — the shared
@@ -414,7 +415,8 @@ def build_xl_solver(problem: Problem, dtype=jnp.float32, interpret=None,
     g1, g2 = problem.node_shape
     plan = XLPlan(problem, dtype, tm=tm)
     g1p, g2p, tm = plan.g1p, plan.g2p, plan.tm
-    args = streamed_operand_set(problem, dtype, g1p, g2p)
+    args = streamed_operand_set(problem, dtype, g1p, g2p,
+                                geometry=geometry, theta=theta)
 
     kernel = functools.partial(
         _mega_kernel, problem, plan, problem.norm == "weighted"

@@ -248,6 +248,8 @@ def build_fused_sharded_solver(
     mesh: Mesh | None = None,
     dtype=jnp.float32,
     interpret: bool | None = None,
+    geometry=None,
+    theta=None,
 ):
     """(jitted solver, args) for the fused two-kernel mesh-sharded solve.
 
@@ -336,7 +338,8 @@ def build_fused_sharded_solver(
         check_vma=not interpret,
     )
 
-    args = _fused_sharded_args(problem, mesh, dtype, g1p, g2p, spec)
+    args = _fused_sharded_args(problem, mesh, dtype, g1p, g2p, spec,
+                               geometry=geometry, theta=theta)
 
     def solver(a, b, d, dinv, rhs):
         w_pad, k, diff, converged, breakdown = mapped(a, b, d, dinv, rhs)
@@ -355,7 +358,8 @@ def build_fused_sharded_solver(
 
 
 def _fused_sharded_args(problem: Problem, mesh: Mesh, dtype,
-                        g1p: int, g2p: int, spec):
+                        g1p: int, g2p: int, spec, geometry=None,
+                        theta=None):
     """Host-f64-assembled (a, b, d, dinv, rhs), rounded once, zero-padded
     to tile-aligned shards and laid out over the mesh.
 
@@ -363,7 +367,8 @@ def _fused_sharded_args(problem: Problem, mesh: Mesh, dtype,
     normalised/guarded diagonal algebra — so K2's preconditioner multiply
     uses the identical rounded-once reciprocal as the single-chip fused
     engine (the two paths share the code, not a copy)."""
-    a64, b64, rhs64 = assembly.assemble_numpy(problem)
+    a64, b64, rhs64 = assembly.assemble_numpy(problem, geometry=geometry,
+                                              theta=theta)
     _an, _as, _bw, _be, d64, dinv64 = interior_normalized(problem, a64, b64)
     np_dtype = assembly.numpy_dtype(dtype)
     sharding = NamedSharding(mesh, spec)

@@ -95,12 +95,13 @@ class _MgShardSetup:
     per-shard precond factory, and the geometry."""
 
     def __init__(self, problem: Problem, mesh: Mesh, dtype, kind: str,
-                 config):
+                 config, geometry=None, theta=None):
         from poisson_ellipse_tpu.mg.engine import resolve_config
 
         if kind not in ("mg", "cheb"):
             raise ValueError(f"unknown preconditioner kind: {kind!r}")
-        a0, b0, rhs0 = assembly.assemble(problem, dtype)
+        a0, b0, rhs0 = assembly.assemble(problem, dtype, geometry=geometry,
+                                         theta=theta)
         cfg = config if config is not None else resolve_config(
             problem, a0, b0, rhs0, kind
         )
@@ -117,7 +118,9 @@ class _MgShardSetup:
         self.kind = kind
         self.cfg = cfg
         self.levels = cfg.levels if kind == "mg" else 1
-        self.hier = mg_coarsen.coefficient_hierarchy(problem)[:self.levels]
+        self.hier = mg_coarsen.coefficient_hierarchy(
+            problem, geometry=geometry, theta=theta
+        )[:self.levels]
         self.px = mesh.shape[AXIS_X]
         self.py = mesh.shape[AXIS_Y]
         self.interpret = mesh.devices.flat[0].platform != "tpu"
@@ -139,7 +142,8 @@ class _MgShardSetup:
                 _pad_to(arr, self.g1p, self.g2p).astype(np_dtype), sharding
             )
             for arr in (self.hier[0]["a"], self.hier[0]["b"],
-                        assembly.assemble_numpy(problem)[2])
+                        assembly.assemble_numpy(problem, geometry=geometry,
+                                                theta=theta)[2])
         ]
         for l in range(1, self.levels):
             for key in ("a", "b"):
@@ -239,6 +243,8 @@ def build_mg_sharded_solver(
     kind: str = "mg",
     config=None,
     history: bool = False,
+    geometry=None,
+    theta=None,
 ):
     """(jitted solver_fn, args) for the mesh-sharded preconditioned solve.
 
@@ -251,7 +257,8 @@ def build_mg_sharded_solver(
     """
     if mesh is None:
         mesh = make_mesh()
-    setup = _MgShardSetup(problem, mesh, dtype, kind, config)
+    setup = _MgShardSetup(problem, mesh, dtype, kind, config,
+                          geometry=geometry, theta=theta)
     px, py, bm, bn = setup.px, setup.py, setup.bm, setup.bn
     interpret = setup.interpret
     spec = setup.spec

@@ -335,6 +335,8 @@ def build_sharded_solver(
     assembly_mode: str = "host",
     stencil_impl: str = "xla",
     history: bool = False,
+    geometry=None,
+    theta=None,
 ):
     """Return (jitted solver_fn, args) for the mesh-sharded solve.
 
@@ -371,6 +373,12 @@ def build_sharded_solver(
     """
     if mesh is None:
         mesh = make_mesh()
+    if geometry is not None and assembly_mode != "host":
+        raise ValueError(
+            "SDF geometry assembles on the HOST in f64 (the quadrature "
+            "path of ops.assembly); assembly_mode='device' traces the "
+            "closed-form ellipse only"
+        )
     if history and stencil_impl not in ("xla", "pallas"):
         raise ValueError(
             "history capture covers the classical sharded loops "
@@ -390,7 +398,9 @@ def build_sharded_solver(
             build_pipelined_sharded_solver,
         )
 
-        return build_pipelined_sharded_solver(problem, mesh, dtype)
+        return build_pipelined_sharded_solver(
+            problem, mesh, dtype, geometry=geometry, theta=theta
+        )
     if stencil_impl == "fused":
         # the two-kernel fused iteration composed with the mesh — its own
         # carry layout (rotated loop) and tile-aligned shard padding live
@@ -404,7 +414,9 @@ def build_sharded_solver(
             build_fused_sharded_solver,
         )
 
-        return build_fused_sharded_solver(problem, mesh, dtype)
+        return build_fused_sharded_solver(
+            problem, mesh, dtype, geometry=geometry, theta=theta
+        )
     px = mesh.shape[AXIS_X]
     py = mesh.shape[AXIS_Y]
     # interpret is a property of the MESH devices, not the process default
@@ -442,7 +454,8 @@ def build_sharded_solver(
             check_vma=not (stencil_impl == "pallas" and interpret),
         )
 
-        args = _host_sharded_args(problem, mesh, dtype, g1p, g2p, spec)
+        args = _host_sharded_args(problem, mesh, dtype, g1p, g2p, spec,
+                                  geometry=geometry, theta=theta)
     elif assembly_mode == "device":
 
         def shard_fn():
@@ -699,10 +712,12 @@ def _pad_to(arr, g1p: int, g2p: int):
 
 
 def _host_sharded_args(problem: Problem, mesh: Mesh, dtype,
-                       g1p: int, g2p: int, spec):
+                       g1p: int, g2p: int, spec, geometry=None, theta=None):
     """Host-f64-assembled a/b/rhs, zero-padded to even shards and laid out
-    over the mesh (the "host" assembly mode's operand set)."""
-    a, b, rhs = assembly.assemble_numpy(problem)
+    over the mesh (the "host" assembly mode's operand set). ``geometry``/
+    ``theta`` select the SDF quadrature assembly (``ops.assembly``)."""
+    a, b, rhs = assembly.assemble_numpy(problem, geometry=geometry,
+                                        theta=theta)
     np_dtype = assembly.numpy_dtype(dtype)
     sharding = NamedSharding(mesh, spec)
     return tuple(

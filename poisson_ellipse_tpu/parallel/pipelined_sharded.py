@@ -270,6 +270,8 @@ def build_pipelined_sharded_solver(
     problem: Problem,
     mesh: Mesh | None = None,
     dtype=jnp.float32,
+    geometry=None,
+    theta=None,
 ):
     """(jitted solver, args) for the pipelined mesh-sharded solve.
 
@@ -303,7 +305,8 @@ def build_pipelined_sharded_solver(
         out_specs=(spec, P(), P(), P(), P()),
     )
 
-    args = _host_sharded_args(problem, mesh, dtype, g1p, g2p, spec)
+    args = _host_sharded_args(problem, mesh, dtype, g1p, g2p, spec,
+                              geometry=geometry, theta=theta)
 
     def solver(*arrays):
         x_pad, k, diff, converged, breakdown = mapped(*arrays)

@@ -28,6 +28,13 @@ code the harness CLI contracts to return:
   7         DeviceLossError         a mesh device was lost and no degraded
                                     mesh remains to resume on (or the
                                     degradation budget is exhausted)
+  8         InvalidGeometryError    the geometry admissibility gate
+                                    (``geom.validate``) rejected the problem
+                                    BEFORE any device loop ran: malformed
+                                    spec, empty/under-resolved domain,
+                                    boundary contact, or an assembled
+                                    operator that fails the finite/M-matrix/
+                                    SPD checks
   ========  ======================  =========================================
 
 (exit 0 = converged, 1 = iteration cap reached without convergence — the
@@ -53,6 +60,7 @@ EXIT_TIMEOUT = 4
 EXIT_SHED = 5
 EXIT_SDC = 6
 EXIT_DEVICE_LOSS = 7
+EXIT_INVALID_GEOMETRY = 8
 
 
 class SolveError(RuntimeError):
@@ -140,6 +148,42 @@ class DeviceLossError(SolveError):
 
     classification = "device-loss"
     exit_code = EXIT_DEVICE_LOSS
+
+
+class InvalidGeometryError(SolveError):
+    """The geometry admissibility gate (``geom.validate``) classified the
+    *problem* — not the solver — as unsolvable as stated, before any
+    device loop ran. ``reason`` is the stable machine-readable sub-tag:
+
+      ``malformed-spec``        the JSON geometry spec does not parse into
+                                an SDF tree (unknown kind, wrong arity,
+                                non-finite parameter)
+      ``sdf-nonfinite``         the SDF itself evaluates to NaN/Inf on Ω
+      ``empty-domain``          no sample of Ω lies inside the domain
+      ``under-resolved``        the domain exists but a feature is thinner
+                                than the grid spacing h — invisible to the
+                                node lattice, so the discrete solve would
+                                silently answer a different question
+      ``boundary-contact``      the domain touches the Dirichlet ring of Ω
+                                (the fictitious-domain method needs the
+                                penalty band strictly around D)
+      ``operator-nonfinite``    assembled coefficients carry NaN/Inf
+      ``operator-not-m-matrix`` a face coefficient is <= 0 where the
+                                5-point M-matrix sign structure needs > 0
+      ``operator-asymmetric``   <Au, v> != <u, Av> beyond f64 round-off
+      ``operator-not-spd``      the host Lanczos probe (``obs.spectrum``
+                                over a short f64 diag-PCG) found a
+                                non-positive Ritz value / indefinite pivot
+
+    Serving maps it to the terminal ``invalid`` outcome at ADMISSION —
+    a bad geometry is rejected before it can poison a lane mid-batch."""
+
+    classification = "invalid-geometry"
+    exit_code = EXIT_INVALID_GEOMETRY
+
+    def __init__(self, message: str, reason: str = "invalid"):
+        super().__init__(message)
+        self.reason = reason
 
 
 # status phrasings XLA/Mosaic use for memory exhaustion, across runtime

@@ -78,11 +78,17 @@ from jax import lax
 FAULT_KINDS = (
     "nan", "breakdown", "stagnation", "halo", "oom",
     "halo_bitflip", "psum_corrupt", "device_loss", "straggler",
+    "malformed_spec", "degenerate_geometry",
 )
 
 # dispatch-level faults: consulted by the driver holding the dispatch
 # (guard / meshguard / scheduler), never applied to a carry
 DISPATCH_KINDS = ("oom", "device_loss", "straggler")
+
+# admission-level faults: consulted by the serve scheduler BEFORE the
+# request reaches the queue — they swap the request's geometry spec, so
+# the admission gate (geom.validate) is what gets exercised, not a carry
+ADMISSION_KINDS = ("malformed_spec", "degenerate_geometry")
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -152,6 +158,9 @@ class Fault:
     # straggle duration
     device: int | None = None
     delay_s: float = 0.0
+    # degenerate_geometry: the clamp threshold the swapped-in sliver
+    # spec carries (None = the quadrature default)
+    theta: float | None = None
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -235,6 +244,53 @@ def straggler(delay_s: float, at_iter: int = 0,
     the per-chunk deadline detects."""
     return Fault("straggler", at_iter=at_iter, delay_s=delay_s,
                  device=device)
+
+
+def malformed_spec(request_id: str | None = None) -> Fault:
+    """Swap the addressed request's geometry for an unparseable spec at
+    ADMISSION — what a corrupted/hostile client payload looks like to
+    the serving layer. The admission gate must reject it with the
+    classified ``invalid`` outcome (exit 8) before it touches a lane."""
+    return Fault("malformed_spec", request_id=request_id)
+
+
+def degenerate_geometry(theta: float | None = None,
+                        request_id: str | None = None) -> Fault:
+    """Swap the addressed request's geometry for the canonical
+    sliver-cut domain (:func:`sliver_spec`) at ADMISSION, carrying
+    clamp threshold ``theta``. With the degenerate-cut defense on
+    (``theta`` at its default) the request must SOLVE cleanly — the
+    drill asserts the clamp, not a rejection."""
+    return Fault("degenerate_geometry", request_id=request_id, theta=theta)
+
+
+MALFORMED_SPEC = {"kind": "dodecahedron", "r": -1.0}
+
+
+def sliver_spec(gap_frac: float = 1e-3) -> dict:
+    """The canonical degenerate-cut domain: the reference ellipse with a
+    crack comb of internal slits ``gap_frac`` of a cell wide. Every
+    slit-crossing face gets fraction 1 − gap_frac, whose blend
+    coefficient 1 + gap_frac/ε is an artificial stiff rod INSIDE the
+    domain — unclamped, diag-PCG measurably stalls on it; clamped
+    (θ > gap_frac), the slits snap to full faces and the solve is the
+    plain ellipse's (the defense ``tests/test_geom.py`` measures)."""
+    # slit centers deliberately off every coarse grid's node lines (the
+    # chaos grids are 8-12 cells: node spacings 0.1/0.12/0.15): a slit
+    # that swallows a node ROW is under-resolved by the gate's own rules
+    # — the drill wants the gate to PASS and the clamp to defend
+    half = 0.0006 * gap_frac / 1e-3
+    slits = [
+        {"kind": "rectangle", "x0": -0.9, "x1": 0.9,
+         "y0": 0.017 + 0.123 * k - half,
+         "y1": 0.017 + 0.123 * k + half}
+        for k in (-2, -1, 0, 1, 2)
+    ]
+    return {
+        "kind": "difference",
+        "a": {"kind": "ellipse"},
+        "b": {"kind": "union", "shapes": slits},
+    }
 
 
 class FaultPlan:

@@ -213,7 +213,7 @@ class _ClassicalAdapter:
 
     def __init__(self, problem: Problem, dtype, stencil: str = "xla",
                  interpret=None, operands=None, precond_kind=None,
-                 precond_config=None):
+                 precond_config=None, geometry=None, theta=None):
         from poisson_ellipse_tpu.solver.pcg import (
             advance as pcg_advance,
             init_state as pcg_init_state,
@@ -224,6 +224,8 @@ class _ClassicalAdapter:
         self.stencil = stencil
         self.interpret = interpret
         self.precond_kind = precond_kind
+        self.geometry = geometry
+        self.theta = theta
         self._precond_cfg = None
         if precond_kind is not None:
             from poisson_ellipse_tpu.solver.engine import (
@@ -235,7 +237,8 @@ class _ClassicalAdapter:
             self.engine = "xla" if stencil == "xla" else "pallas"
         a, b, rhs = (
             operands if operands is not None
-            else assembly.assemble(problem, dtype)
+            else assembly.assemble(problem, dtype, geometry=geometry,
+                                   theta=theta)
         )
         self._operands = (a, b, rhs)
         if precond_kind is not None:
@@ -246,7 +249,7 @@ class _ClassicalAdapter:
             # interval over (precond_config), skipping a second probe
             factory, self._precond_cfg = make_precond(
                 problem, dtype, precond_kind, config=precond_config,
-                operands=(a, b, rhs),
+                operands=(a, b, rhs), geometry=geometry, theta=theta,
             )
             precond = factory(a, b)
         else:
@@ -318,7 +321,8 @@ class _ClassicalAdapter:
             return None
         adapter = _ClassicalAdapter(
             # tpulint: disable=TPU001 — escalation is gated on x64 above
-            self.problem, jnp.float64, stencil="xla"
+            self.problem, jnp.float64, stencil="xla",
+            geometry=self.geometry, theta=self.theta,
         )
         # tpulint: disable=TPU001 — escalation is refused without x64
         return adapter, lambda state: _cast_carry(state, jnp.float64)
@@ -341,19 +345,22 @@ class _ClassicalAdapter:
             adapter = _ClassicalAdapter(
                 self.problem, self.dtype, stencil="xla",
                 operands=self._operands, precond_kind="cheb",
-                precond_config=cheb_cfg,
+                precond_config=cheb_cfg, geometry=self.geometry,
+                theta=self.theta,
             )
             return adapter, lambda state: state
         if self.precond_kind == "cheb":
             adapter = _ClassicalAdapter(
                 self.problem, self.dtype, stencil="xla",
-                operands=self._operands,
+                operands=self._operands, geometry=self.geometry,
+                theta=self.theta,
             )
             return adapter, lambda state: state
         if self.stencil == "pallas":
             adapter = _ClassicalAdapter(
                 self.problem, self.dtype, stencil="xla",
-                operands=self._operands,
+                operands=self._operands, geometry=self.geometry,
+                theta=self.theta,
             )
             return adapter, lambda state: state
         return None
@@ -369,15 +376,18 @@ class _PipelinedAdapter:
     K, ZR, DIFF, CONV, BD = 0, 8, 9, 10, 11
 
     def __init__(self, problem: Problem, dtype, stencil: str = "xla",
-                 interpret=None):
+                 interpret=None, geometry=None, theta=None):
         from poisson_ellipse_tpu.ops import pipelined_pcg as _pp
 
         self.problem = problem
         self.dtype = dtype
         self.stencil = stencil
         self.interpret = interpret
+        self.geometry = geometry
+        self.theta = theta
         self.engine = "pipelined" if stencil == "xla" else "pipelined-pallas"
-        a, b, rhs = assembly.assemble(problem, dtype)
+        a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
+                                      theta=theta)
         self._operands = (a, b, rhs)
         self.rhs_norm = float(jnp.sqrt(jnp.sum(rhs.astype(jnp.float32) ** 2)))
         self._init = lambda: _pp.init_state(
@@ -462,7 +472,8 @@ class _PipelinedAdapter:
             return None
         adapter = _PipelinedAdapter(
             # tpulint: disable=TPU001 — escalation is gated on x64 above
-            self.problem, jnp.float64, stencil="xla"
+            self.problem, jnp.float64, stencil="xla",
+            geometry=self.geometry, theta=self.theta,
         )
         # tpulint: disable=TPU001 — escalation is refused without x64
         return adapter, lambda state: _cast_carry(state, jnp.float64)
@@ -474,7 +485,8 @@ class _PipelinedAdapter:
         # rounded-once (a, b, rhs), so no reassembly on the fault path.
         adapter = _ClassicalAdapter(
             self.problem, self.dtype, stencil="xla",
-            operands=self._operands,
+            operands=self._operands, geometry=self.geometry,
+            theta=self.theta,
         )
         return adapter, self._to_classical
 
@@ -747,7 +759,13 @@ class _PipelinedShardedAdapter:
 
 
 def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
-                  abft: bool = False):
+                  abft: bool = False, geometry=None, theta=None):
+    if geometry is not None and mesh is not None:
+        raise ValueError(
+            "guarded sharded solves do not take geometry= yet — run the "
+            "sharded build (parallel.pcg_sharded.build_sharded_solver) "
+            "directly, or guard the single-chip engines"
+        )
     if abft and mesh is None:
         raise ValueError(
             "abft covers the sharded engines (the checksum partials ride "
@@ -779,25 +797,30 @@ def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
             "resumable stepper form"
         )
     if engine == "xla":
-        return _ClassicalAdapter(problem, dtype, stencil="xla")
+        return _ClassicalAdapter(problem, dtype, stencil="xla",
+                                 geometry=geometry, theta=theta)
     if engine in ("mg-pcg", "cheb-pcg"):
         from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
 
         return _ClassicalAdapter(
             problem, dtype, stencil="xla",
             precond_kind=PRECOND_KIND_BY_ENGINE[engine],
+            geometry=geometry, theta=theta,
         )
     if engine == "pallas":
         return _ClassicalAdapter(
-            problem, dtype, stencil="pallas", interpret=interpret
+            problem, dtype, stencil="pallas", interpret=interpret,
+            geometry=geometry, theta=theta,
         )
     if engine == "pipelined":
         return _PipelinedAdapter(
-            problem, dtype, stencil="xla", interpret=interpret
+            problem, dtype, stencil="xla", interpret=interpret,
+            geometry=geometry, theta=theta,
         )
     if engine == "pipelined-pallas":
         return _PipelinedAdapter(
-            problem, dtype, stencil="pallas", interpret=interpret
+            problem, dtype, stencil="pallas", interpret=interpret,
+            geometry=geometry, theta=theta,
         )
     if engine in ("batched", "batched-pipelined"):
         raise ValueError(
@@ -825,6 +848,9 @@ def guarded_solve(
     faults: Optional[FaultPlan] = None,
     interpret=None,
     abft: bool = False,
+    geometry=None,
+    theta=None,
+    validate_geometry: bool = True,
 ) -> GuardedResult:
     """Solve with failure detection and the recovery ladder (module
     docstring). Loop engines (xla / pallas / pipelined / pipelined-pallas
@@ -860,6 +886,19 @@ def guarded_solve(
     plan = faults if faults is not None else FaultPlan()
     events: list[RecoveryEvent] = []
 
+    if geometry is not None:
+        from poisson_ellipse_tpu.geom import sdf as geom_sdf
+        from poisson_ellipse_tpu.geom import validate as geom_validate
+
+        if isinstance(geometry, dict):
+            geometry = geom_sdf.from_spec(geometry)
+        if validate_geometry:
+            # the admissibility gate runs before ANY device loop — a bad
+            # problem is a classified exit-8 rejection, never a recovery
+            # ladder walk (``validate_geometry=False`` is the fuzz
+            # harness's bypass drill)
+            geom_validate.validate(problem, geometry, theta=theta)
+
     if mesh is None and engine in ("auto", "resident", "streamed", "xl",
                                    "fused"):
         if abft:
@@ -871,11 +910,11 @@ def guarded_solve(
         return _guarded_whole_solve(
             problem, engine, dtype, interpret=interpret, chunk=chunk,
             max_recoveries=max_recoveries, timeout=timeout, t0=t0,
-            plan=plan, events=events,
+            plan=plan, events=events, geometry=geometry, theta=theta,
         )
 
     adapter = _make_adapter(problem, engine, dtype, mesh, interpret,
-                            abft=abft)
+                            abft=abft, geometry=geometry, theta=theta)
     return _run_chunked(
         problem, adapter, chunk=chunk, max_recoveries=max_recoveries,
         timeout=timeout, t0=t0, plan=plan, events=events,
@@ -1114,7 +1153,7 @@ def _fire_whole_solve_oom(plan: FaultPlan) -> None:
 
 def _guarded_whole_solve(problem, engine, dtype, *, interpret, chunk,
                          max_recoveries, timeout, t0, plan,
-                         events) -> GuardedResult:
+                         events, geometry=None, theta=None) -> GuardedResult:
     """Guard for the VMEM mega-kernel engines: health-check the whole
     solve's result, degrade down the capacity ladder on OOM or an
     unhealthy result, and finish on the chunked guarded xla loop (which
@@ -1140,8 +1179,11 @@ def _guarded_whole_solve(problem, engine, dtype, *, interpret, chunk,
             _fire_whole_solve_oom(plan)
             # one build per capacity rung is the whole-solve guard's
             # fallback, bounded by the ladder
-            # tpulint: disable=TPU013
-            solver, args, _ = build_solver(problem, cand, dtype, interpret)
+            solver, args, _ = build_solver(
+                # tpulint: disable=TPU013 — one build per capacity rung
+                problem, cand, dtype, interpret, geometry=geometry,
+                theta=theta, validate_geometry=False,
+            )
             result = solver(*args)
             healthy = (
                 bool(jnp.all(jnp.isfinite(result.w)))
@@ -1182,7 +1224,8 @@ def _guarded_whole_solve(problem, engine, dtype, *, interpret, chunk,
     remaining_timeout = (
         None if timeout is None else max(timeout - (time.monotonic() - t0), 0.1)
     )
-    adapter = _ClassicalAdapter(problem, dtype, stencil="xla")
+    adapter = _ClassicalAdapter(problem, dtype, stencil="xla",
+                                geometry=geometry, theta=theta)
     return _run_chunked(
         problem, adapter, chunk=chunk,
         max_recoveries=max(max_recoveries - nrec, 0),

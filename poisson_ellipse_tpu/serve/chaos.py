@@ -82,6 +82,8 @@ def run_chaos(
     deadline_s: Optional[float] = None,
     max_retries: int = 2,
     mesh_kill_request: Optional[int] = None,
+    malformed_request: Optional[int] = None,
+    degenerate_request: Optional[int] = None,
 ) -> ChaosReport:
     """Drive one seeded chaos stream; see the module docstring.
 
@@ -101,6 +103,15 @@ def run_chaos(
     (``Scheduler._degrade_mesh``) — and the zero-lost/zero-double/
     all-classified invariants are asserted across a device kill, not
     just a process kill.
+
+    ``malformed_request`` / ``degenerate_request`` arm the GEOMETRY
+    drill: the named request's geometry spec is swapped at admission
+    (``faultinject.malformed_spec`` / ``degenerate_geometry``). The
+    malformed one must end in the terminal classified ``invalid``
+    outcome without ever touching a lane; the degenerate (sliver-cut)
+    one must pass the gate and SOLVE cleanly under the clamp — and in
+    both cases every OTHER request's lane runs clean (zero poisoning,
+    asserted by the same global invariants).
     """
     if n_requests < 1:
         raise ValueError("need at least one request")
@@ -128,6 +139,15 @@ def run_chaos(
         faults.append(Fault(
             "device_loss", at_iter=1, device=0,
             request_id=_chaos_id(mesh_kill_request),
+        ))
+    if malformed_request is not None and malformed_request < n_requests:
+        faults.append(Fault(
+            "malformed_spec", request_id=_chaos_id(malformed_request),
+        ))
+    if degenerate_request is not None and degenerate_request < n_requests:
+        faults.append(Fault(
+            "degenerate_geometry",
+            request_id=_chaos_id(degenerate_request),
         ))
 
     def make_scheduler():

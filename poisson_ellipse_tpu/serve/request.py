@@ -23,6 +23,12 @@ map onto the process exit-code contract of ``resilience.errors``
   shed             5     rejected at admission (queue full / projected
                          deadline miss); never queued, safe to resubmit after
                          ``retry_after_s``
+  invalid          8     the request's geometry spec failed the admissibility
+                         gate (``geom.validate``) AT ADMISSION — malformed,
+                         empty, under-resolved, or operator-inadmissible; the
+                         request was never journaled or dispatched (retracted
+                         from the queue before anything durable saw it), so a
+                         bad geometry can never poison a lane mid-batch
   ===============  ====  =====================================================
 
 The wire/journal form of a request (:meth:`ServeRequest.spec`) is a flat
@@ -41,11 +47,12 @@ import numpy as np
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.resilience.errors import (
     EXIT_DIVERGED,
+    EXIT_INVALID_GEOMETRY,
     EXIT_SHED,
     EXIT_TIMEOUT,
 )
 
-OUTCOMES = ("completed", "cap", "failed", "deadline-miss", "shed")
+OUTCOMES = ("completed", "cap", "failed", "deadline-miss", "shed", "invalid")
 
 EXIT_BY_OUTCOME = {
     "completed": 0,
@@ -53,6 +60,7 @@ EXIT_BY_OUTCOME = {
     "failed": EXIT_DIVERGED,
     "deadline-miss": EXIT_TIMEOUT,
     "shed": EXIT_SHED,
+    "invalid": EXIT_INVALID_GEOMETRY,
 }
 
 
@@ -78,12 +86,34 @@ class ServeRequest:
     deadline: Optional[float] = None
     max_retries: int = 1
     request_id: str = dataclasses.field(default_factory=new_request_id)
+    # the JSON SDF spec of an arbitrary domain (None = the hard-coded
+    # ellipse) and its degenerate-cut clamp threshold — validated at
+    # ADMISSION (never mid-solve) against ``geom.validate``
+    geometry: Optional[dict] = None
+    theta: Optional[float] = None
     # scheduler bookkeeping (not part of the wire spec)
     enqueued_t: Optional[float] = None
     admitted_t: Optional[float] = None
     not_before: float = 0.0
     attempt: int = 0
     dispatched: bool = False
+    # the parsed SDF tree, cached after admission validation
+    _geom_obj: object = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def geometry_sdf(self):
+        """The parsed SDF tree of ``geometry`` (None for the default
+        ellipse); parsing classifies a malformed spec
+        (``InvalidGeometryError``), and the result is cached so replayed
+        requests parse once."""
+        if self.geometry is None:
+            return None
+        if self._geom_obj is None:
+            from poisson_ellipse_tpu.geom import sdf as geom_sdf
+
+            self._geom_obj = geom_sdf.from_spec(self.geometry)
+        return self._geom_obj
 
     def spec(self) -> dict:
         """The flat JSON form the journal persists and replay rebuilds.
@@ -107,6 +137,8 @@ class ServeRequest:
                 else max(self.deadline - self.enqueued_t, 0.0)
             ),
             "max_retries": self.max_retries,
+            "geometry": self.geometry,
+            "theta": self.theta,
         }
 
     @classmethod
@@ -124,6 +156,8 @@ class ServeRequest:
             deadline=None if left is None else now + left,
             max_retries=spec.get("max_retries", 1),
             request_id=spec["request_id"],
+            geometry=spec.get("geometry"),
+            theta=spec.get("theta"),
         )
 
 

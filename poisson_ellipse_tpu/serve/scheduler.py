@@ -129,12 +129,17 @@ def _refill_scatter(a3, b3, mask, h1, h2, delta, state, unit,
     return a3, b3, mask, h1, h2, delta, state
 
 
-def _embed_request(problem: Problem, bucket: tuple[int, int], np_dtype):
+def _embed_request(problem: Problem, bucket: tuple[int, int], np_dtype,
+                   geometry=None, theta=None):
     """Pad-and-mask one request into a bucket: zero-padded operands,
     interior mask over the true problem (the ``runtime.compile_cache``
-    embedding, sliced per lane)."""
+    embedding, sliced per lane). ``geometry``/``theta`` select the SDF
+    quadrature assembly — a host-side operand fact, so an arbitrary
+    domain rides the SAME bucket executable (shapes are the only
+    compile keys)."""
     Mb, Nb = bucket
-    a, b, r = assembly.assemble_numpy(problem)
+    a, b, r = assembly.assemble_numpy(problem, geometry=geometry,
+                                      theta=theta)
     g1, g2 = problem.M + 1, problem.N + 1
     pad2 = ((0, Mb + 1 - g1), (0, Nb + 1 - g2))
     mask = np.zeros((Mb + 1, Nb + 1), np_dtype)
@@ -289,6 +294,63 @@ class Scheduler:
             req.request_id = request_id
         return self.submit_request(req)
 
+    def _apply_admission_faults(self, req: ServeRequest) -> None:
+        """Fire request-addressed ADMISSION faults (``malformed_spec`` /
+        ``degenerate_geometry``): the request's geometry spec is swapped
+        BEFORE validation, so the drill exercises the real gate."""
+        from poisson_ellipse_tpu.resilience import faultinject
+
+        for fault in self.faults.faults:
+            if (fault.fired or fault.request_id != req.request_id
+                    or fault.kind not in faultinject.ADMISSION_KINDS):
+                continue
+            fault.fired = True
+            obs_trace.event(
+                "serve:fault", request_id=req.request_id, lane=None,
+                kind=fault.kind, at_iter=0,
+            )
+            if fault.kind == "malformed_spec":
+                req.geometry = dict(faultinject.MALFORMED_SPEC)
+            else:
+                req.geometry = faultinject.sliver_spec()
+                req.theta = fault.theta
+            req._geom_obj = None
+
+    def _validate_geometry(self, req: ServeRequest) -> Optional[ServeResult]:
+        """The admission rung of the geometry gate: a request carrying a
+        geometry spec is validated host-side AT ADMISSION — a bad one
+        ends in the terminal classified ``invalid`` outcome (exit 8)
+        without ever being journaled or dispatched. Mid-solve geometry
+        failure is structurally impossible: no lane sees operands that
+        did not pass this gate. Runs AFTER the bounded queue's capacity
+        check: validation is real host work (quadrature assembly + the
+        Lanczos probe), and overload must hit the cheap backpressure
+        reject first, not an unmetered validation grinder."""
+        if req.geometry is None:
+            return None
+        from poisson_ellipse_tpu.geom import validate as geom_validate
+        from poisson_ellipse_tpu.resilience.errors import (
+            InvalidGeometryError,
+        )
+
+        try:
+            geom_validate.validate(
+                req.problem, req.geometry_sdf(), theta=req.theta
+            )
+        except InvalidGeometryError as e:
+            result = ServeResult(
+                request_id=req.request_id, outcome="invalid",
+                detail=e.reason,
+            )
+            self.results[req.request_id] = result
+            obs_metrics.counter("invalid_geometry_total").inc()
+            obs_trace.event(
+                "serve:invalid-geometry", request_id=req.request_id,
+                reason=e.reason,
+            )
+            return result
+        return None
+
     def submit_request(self, req: ServeRequest) -> Optional[ServeResult]:
         prior = self.results.get(req.request_id)
         if prior is not None and prior.outcome == "shed" and not prior.dispatched:
@@ -307,6 +369,7 @@ class Scheduler:
                 request_id=req.request_id, outcome="shed",
                 detail="duplicate-request-id",
             )
+        self._apply_admission_faults(req)
         accepted, retry_after, reason = self.queue.admit(req)
         if not accepted:
             result = ServeResult(
@@ -315,6 +378,12 @@ class Scheduler:
             )
             self.results[req.request_id] = result
             return result
+        invalid = self._validate_geometry(req)
+        if invalid is not None:
+            # compensate the admit: the request leaves the queue before
+            # anything durable (journal) or dispatchable sees it
+            self.queue.retract(req, "invalid-geometry")
+            return invalid
         if self.journal is not None:
             # write-ahead: the admission is acknowledged only once the
             # journal holds it; a failed journal write un-queues the
@@ -509,7 +578,10 @@ class Scheduler:
         lane's trajectory is bit-identical to a fresh lane-0 solve of
         the same embedding (pinned in ``tests/test_batched.py``)."""
         p = req.problem
-        a_p, b_p, r_p, m_p = _embed_request(p, ctx.bucket, self._np_dtype)
+        a_p, b_p, r_p, m_p = _embed_request(
+            p, ctx.bucket, self._np_dtype,
+            geometry=req.geometry_sdf(), theta=req.theta,
+        )
         # the lane's fresh carry comes from the same eager init_state
         # every other entry path uses (the bit-parity pin's reference);
         # the scatter into the batch is one fused dispatch
@@ -739,7 +811,10 @@ class Scheduler:
         try:
             guarded = guarded_solve(
                 req.problem, "xla", self.dtype, chunk=self.chunk,
-                timeout=timeout,
+                timeout=timeout, geometry=req.geometry_sdf(),
+                theta=req.theta,
+                # already validated at admission; never re-gate mid-ladder
+                validate_geometry=False,
             )
         except SolveError as e:
             outcome = (

@@ -133,9 +133,21 @@ def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
 
 def build_solver(
     problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
-    history: bool = False, lanes: int = 1,
+    history: bool = False, lanes: int = 1, geometry=None, theta=None,
+    validate_geometry: bool = True,
 ):
     """(jitted solver, args, resolved_engine) for a single-chip solve.
+
+    ``geometry`` selects an arbitrary SDF domain (a ``geom.sdf`` shape
+    or its JSON spec): the operands are assembled through the bisection
+    quadrature (``geom.quadrature``) with the degenerate-cut clamp at
+    ``theta``, and — unless ``validate_geometry=False`` — the
+    admissibility gate (``geom.validate``) runs FIRST, raising the
+    classified ``InvalidGeometryError`` (exit 8) before anything is
+    built or dispatched. ``geometry=None`` (default) keeps the
+    closed-form ellipse bit-identical to every pre-geometry release.
+    Every engine accepts the same ``geometry=``; the assembly is a
+    host-side operand fact, not an engine property.
 
     ``lanes`` selects the batch width of the lane-batched engines
     (``batched`` / ``batched-pipelined``): their solver runs ``lanes``
@@ -170,6 +182,16 @@ def build_solver(
             "needs the lane-batched engines ('batched' / "
             "'batched-pipelined')"
         )
+    if geometry is not None:
+        from poisson_ellipse_tpu.geom import sdf as geom_sdf
+        from poisson_ellipse_tpu.geom import validate as geom_validate
+
+        if isinstance(geometry, dict):
+            geometry = geom_sdf.from_spec(geometry)  # classifies malformed
+        if validate_geometry:
+            # the admissibility gate: a bad problem fails HERE, with the
+            # classified exit-8 error, before any build/compile/dispatch
+            geom_validate.validate(problem, geometry, theta=theta)
     if engine in BATCHED_ENGINES:
         if history:
             raise ValueError(
@@ -190,7 +212,8 @@ def build_solver(
         run = (
             pcg_batched if engine == "batched" else pcg_batched_pipelined
         )
-        args = batched_operands(problem, lanes, dtype)
+        args = batched_operands(problem, lanes, dtype, geometry=geometry,
+                                theta=theta)
         # no donation: the build-once-call-many contract re-feeds these
         # operands on every dispatch (the timing protocols re-dispatch)
         solver = jax.jit(  # tpulint: disable=TPU004
@@ -216,8 +239,10 @@ def build_solver(
         last_err = None
         for cand in chain:
             try:
+                # the gate already ran above — don't re-validate per rung
                 solver, args, _ = build_solver(
-                    problem, cand, dtype, interpret
+                    problem, cand, dtype, interpret, geometry=geometry,
+                    theta=theta, validate_geometry=False,
                 )
                 if cand != "xla" and jax.default_backend() == "tpu":
                     # force Mosaic compilation now, where we can catch it.
@@ -245,21 +270,31 @@ def build_solver(
     if engine == "resident":
         from poisson_ellipse_tpu.ops.resident_pcg import build_resident_solver
 
-        solver, args = build_resident_solver(problem, dtype, interpret=interpret)
+        solver, args = build_resident_solver(
+            problem, dtype, interpret=interpret, geometry=geometry,
+            theta=theta,
+        )
     elif engine == "streamed":
         from poisson_ellipse_tpu.ops.streamed_pcg import build_streamed_solver
 
-        solver, args = build_streamed_solver(problem, dtype, interpret=interpret)
+        solver, args = build_streamed_solver(
+            problem, dtype, interpret=interpret, geometry=geometry,
+            theta=theta,
+        )
     elif engine == "fused":
         from poisson_ellipse_tpu.ops.fused_pcg import build_fused_solver
 
         solver, args = build_fused_solver(
-            problem, dtype, interpret=interpret, history=history
+            problem, dtype, interpret=interpret, history=history,
+            geometry=geometry, theta=theta,
         )
     elif engine == "xl":
         from poisson_ellipse_tpu.ops.xl_pcg import build_xl_solver
 
-        solver, args = build_xl_solver(problem, dtype, interpret=interpret)
+        solver, args = build_xl_solver(
+            problem, dtype, interpret=interpret, geometry=geometry,
+            theta=theta,
+        )
     elif engine in PRECOND_ENGINES:
         # the multigrid / Chebyshev preconditioned classical loop: the
         # hierarchy + Lanczos bounds are resolved at build time, the
@@ -267,14 +302,16 @@ def build_solver(
         from poisson_ellipse_tpu.mg.engine import build_precond_solver
 
         solver, args, _ = build_precond_solver(
-            problem, engine, dtype, history=history
+            problem, engine, dtype, history=history, geometry=geometry,
+            theta=theta,
         )
     elif engine in ("pipelined", "pipelined-pallas"):
         from poisson_ellipse_tpu.ops.pipelined_pcg import pcg_pipelined
 
         import jax
 
-        a, b, rhs = assembly.assemble(problem, dtype)
+        a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
+                                      theta=theta)
         stencil = "pallas" if engine == "pipelined-pallas" else "xla"
         # no donation: same build-once-call-many contract as the xla path
         solver = jax.jit(  # tpulint: disable=TPU004
@@ -289,7 +326,8 @@ def build_solver(
         # kernel (stage4's one-kernel-per-op structure on one chip)
         import jax
 
-        a, b, rhs = assembly.assemble(problem, dtype)
+        a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
+                                      theta=theta)
         stencil = engine
         # no donation: the build-once-call-many contract re-feeds these
         # operands on every dispatch (bench --repeat, chained solves)
@@ -306,17 +344,21 @@ def build_solver(
 
 def solve(
     problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
-    history: bool = False, lanes: int = 1,
+    history: bool = False, lanes: int = 1, geometry=None, theta=None,
+    validate_geometry: bool = True,
 ):
     """Assemble and solve single-chip with the selected engine.
 
     ``history=True`` returns ``(PCGResult, obs.ConvergenceTrace)`` — the
     on-device per-iteration convergence telemetry (see ``build_solver``).
     ``lanes`` selects the batch width of the batched engines, whose
-    result is per-lane (see ``build_solver``).
+    result is per-lane (see ``build_solver``). ``geometry``/``theta``
+    select an arbitrary SDF domain through the admissibility gate (see
+    ``build_solver``; exit-8 classified rejection before dispatch).
     """
     solver, args, _ = build_solver(
         problem, engine, dtype, interpret=interpret, history=history,
-        lanes=lanes,
+        lanes=lanes, geometry=geometry, theta=theta,
+        validate_geometry=validate_geometry,
     )
     return solver(*args)

@@ -303,3 +303,54 @@ def test_tolerances_load_from_pyproject_with_defaults(tmp_path):
     )
     with pytest.raises(SystemExit, match="t-solver-pct"):
         bc.load_tolerances(str(tmp_path))
+
+
+# ------------------------------------------------------- geometry rows
+
+
+def _geometry_row(**overrides):
+    row = {
+        "grid": [400, 600], "assembly_cf_s": 0.2, "assembly_quad_s": 1.0,
+        "assembly_overhead_x": 5.0, "max_frac_err": 1e-14,
+        "sdf_ellipse_iters": 42, "oracle_iters": 42,
+        "composite": {"domain": "ellipse-minus-hole", "t_solver_s": 0.5,
+                      "iters": 40, "converged": True, "min_u": 0.0},
+    }
+    row.update(overrides)
+    return row
+
+
+def test_geometry_composite_slowdown_is_a_regression():
+    old = make_round(geometry=_geometry_row())
+    comp = dict(_geometry_row()["composite"])
+    comp["t_solver_s"] = 0.5 * (1 + TOL["geometry-t-pct"]) * 1.01
+    new = make_round(geometry=_geometry_row(composite=comp))
+    assert regressions_between(old, new) == [
+        ("geometry_t_solver_s", "composite")
+    ]
+    comp["t_solver_s"] = 0.5 * (1 + TOL["geometry-t-pct"]) * 0.99
+    new = make_round(geometry=_geometry_row(composite=comp))
+    assert regressions_between(old, new) == []
+
+
+def test_geometry_assembly_slowdown_and_frac_err_are_regressions():
+    old = make_round(geometry=_geometry_row())
+    new = make_round(geometry=_geometry_row(
+        assembly_quad_s=1.0 * (1 + TOL["geometry-assembly-pct"]) * 1.01
+    ))
+    assert regressions_between(old, new) == [
+        ("geometry_assembly_quad_s", "geometry")
+    ]
+    # the parity bound is a hard pin, not a relative drift band
+    new = make_round(geometry=_geometry_row(max_frac_err=1e-11))
+    assert regressions_between(old, new) == [
+        ("geometry_max_frac_err", "geometry")
+    ]
+
+
+def test_geometry_only_in_one_round_is_noted_not_failed():
+    old = make_round()
+    new = make_round(geometry=_geometry_row())
+    regs, notes = bc.compare(old, new, TOL)
+    assert not regs
+    assert any("geometry" in n for n in notes)

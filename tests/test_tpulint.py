@@ -27,9 +27,9 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_fourteen_rules():
+def test_registry_has_all_fifteen_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
-        "TPU010", "TPU011", "TPU012", "TPU013", "TPU014",
+        "TPU010", "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -1502,6 +1502,79 @@ def test_tpu014_backoff_fns_configurable_and_suppression():
                     continue
     """
     assert codes_of(suppressed) == []
+
+
+# -- TPU015: host round-trips on traced / xp-dual values --------------------
+
+
+def test_tpu015_positive_float_in_jitted_fn():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            scale = x * 2.0
+            return float(scale)
+    """
+    # the same site is also a host sync (TPU003 — a jitted float() is
+    # both); select isolates the purity rule's own verdict
+    assert codes_of(src, select=frozenset({"TPU015"})) == ["TPU015"]
+
+
+def test_tpu015_positive_item_in_xp_dual_fn():
+    src = """
+        def segment_length(x0, y0, xp):
+            v = xp.sqrt(x0 * x0 + y0 * y0)
+            return v.item()
+    """
+    assert codes_of(src) == ["TPU015"]
+
+
+def test_tpu015_positive_bool_on_xp_dual_param():
+    src = """
+        def is_inside(shape, x, y, xp):
+            return bool(shape(x, y, xp) < 0)
+    """
+    assert codes_of(src) == ["TPU015"]
+
+
+def test_tpu015_negative_static_facts_and_host_driver():
+    # x.shape/len() are static facts, and a plain host driver (no jit,
+    # no xp param) converting device results is the normal idiom
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * len(x.shape)
+
+        def driver(solver, args):
+            result = solver(*args)
+            return float(result.diff), int(result.iters)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu015_negative_xp_module_itself_untainted():
+    # calling int() on a non-parameter-derived value inside an xp-dual
+    # fn is fine; so is arithmetic on xp itself
+    src = """
+        def fractions(x, xp, samples=16):
+            k = int(samples) + 1
+            return xp.linspace(0.0, 1.0, k) * x
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu015_suppression_comment():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)  # tpulint: disable=TPU015
+    """
+    assert codes_of(src, select=frozenset({"TPU015"})) == []
 
 
 def test_suppression_is_per_code_not_blanket():

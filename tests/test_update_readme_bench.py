@@ -408,3 +408,45 @@ def test_missing_marker_is_a_curated_error(workspace):
     with pytest.raises(SystemExit) as exc:
         urb.regenerate(str(readme), str(artifact), root=str(tmp))
     assert "marker" in str(exc.value)
+
+
+def test_geometry_field_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        geometry={
+            "grid": [400, 600], "assembly_cf_s": 0.2,
+            "assembly_quad_s": 1.0, "assembly_overhead_x": 5.0,
+            "max_frac_err": 3.7e-15, "sdf_ellipse_iters": 99,
+            "oracle_iters": 99,
+            "composite": {"domain": "ellipse-minus-hole",
+                          "t_solver_s": 0.75, "iters": 88,
+                          "converged": True, "min_u": 0.0},
+        }
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Geometry (SDF quadrature" in text
+    assert "3.7e-15" in text
+    assert "Composite domain (ellipse-minus-hole)" in text
+    assert "maximum principle held" in text
+
+
+def test_geometry_field_absent_or_failed_is_supported(workspace):
+    # pre-geometry artifacts lack the key; a failed composite half
+    # (no t_solver_s) renders the parity line only
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    assert "Geometry (SDF quadrature" not in readme.read_text()
+    artifact.write_text(json.dumps(make_artifact(
+        geometry={
+            "grid": [400, 600], "max_frac_err": 1e-14,
+            "sdf_ellipse_iters": 99, "oracle_iters": 99,
+            "composite": {"domain": "ellipse-minus-hole",
+                          "converged": False},
+        }
+    )))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Geometry (SDF quadrature" in text
+    assert "Composite domain" not in text

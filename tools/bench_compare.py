@@ -64,6 +64,11 @@ DEFAULT_TOLERANCES = {
     # (the quantity is already a percent — a fractional band of a small
     # percent would be noise-tight)
     "abft-pp": 1.0,
+    # geometry rows: the composite-domain solve shares the wall-clock
+    # noise floor; quadrature assembly is host work (noisier on a
+    # shared CI box), so its band is wider
+    "geometry-t-pct": 0.25,
+    "geometry-assembly-pct": 0.50,
 }
 
 # scalar-row artifact keys carrying {grid, t_solver_s, iters}
@@ -340,6 +345,40 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
             ))
     elif (o_row is None) != (n_row is None):
         notes.append("abft: only in one round, skipped")
+
+    # the geometry key: the composite-domain solve time and the
+    # quadrature assembly cost, plus the parity fields as hard pins —
+    # face-fraction error growing past the acceptance bound is a
+    # regression even within a round that still said valid
+    o_geo, n_geo = old.get("geometry"), new.get("geometry")
+    if isinstance(o_geo, dict) and isinstance(n_geo, dict):
+        o_c = (o_geo.get("composite") or {}).get("t_solver_s")
+        n_c = (n_geo.get("composite") or {}).get("t_solver_s")
+        if not one_sided("geometry composite t_solver_s", "geometry",
+                         o_c, n_c) and o_c and n_c is not None:
+            limit = tol["geometry-t-pct"]
+            if n_c > o_c * (1.0 + limit):
+                regressions.append(Regression(
+                    "geometry_t_solver_s", "composite", o_c, n_c,
+                    f"+{(n_c / o_c - 1):.0%} > +{limit:.0%}",
+                ))
+        o_a, n_a = o_geo.get("assembly_quad_s"), n_geo.get("assembly_quad_s")
+        if not one_sided("geometry assembly_quad_s", "geometry",
+                         o_a, n_a) and o_a and n_a is not None:
+            limit = tol["geometry-assembly-pct"]
+            if n_a > o_a * (1.0 + limit):
+                regressions.append(Regression(
+                    "geometry_assembly_quad_s", "geometry", o_a, n_a,
+                    f"+{(n_a / o_a - 1):.0%} > +{limit:.0%}",
+                ))
+        o_e, n_e = o_geo.get("max_frac_err"), n_geo.get("max_frac_err")
+        if o_e is not None and n_e is not None and n_e > 1e-12:
+            regressions.append(Regression(
+                "geometry_max_frac_err", "geometry", o_e, n_e,
+                "> 1e-12 acceptance bound",
+            ))
+    elif (o_geo is None) != (n_geo is None):
+        notes.append("geometry: only in one round, skipped")
 
     return regressions, notes
 
