@@ -43,6 +43,13 @@ checks):
                 continuous-batching scheduler (``serve.scheduler``,
                 chunk-boundary lane retire/refill) vs the static-batch
                 baseline — valid iff every request completes.
+  fleet       — "fleet" key: aggregate solves/sec through the replicated
+                fleet (``fleet.FleetRouter``) at 1/2/3 replicas under
+                the same mixed Poisson stream (non-decreasing within
+                the serving noise floor), plus the journal-handoff
+                latency p99 of a mid-stream replica kill — valid iff
+                every request completes at every width and the kill
+                round loses nothing (``fleet-agg-pct`` gated).
   abft        — "abft" key: the silent-corruption checks' healthy-path
                 cost at 800×1200 — checks-on vs checks-off T_solver
                 (gate: ≤2% overhead) with the per-iteration collective
@@ -999,6 +1006,134 @@ def bench_serving(n_requests: int = 32, lanes: int = 4,
     return row, ok
 
 
+# noise floor for the replicas-scaling gate: in-process replicas share
+# one chip, so "non-decreasing aggregate throughput" is asserted within
+# the serving wall-clock noise band, not as strict monotonic growth
+FLEET_AGG_NOISE_FRAC = 0.25
+FLEET_REPLICA_COUNTS = (1, 2, 3)
+
+
+def bench_fleet(n_requests: int = 24, lanes: int = 2,
+                grids=((10, 10), (12, 12)), seed: int = 0):
+    """The fleet key: aggregate solves/sec vs replica count, plus the
+    handoff-latency p99 of a mid-stream replica kill.
+
+    The same seeded Poisson stream runs through a 1-, 2- and 3-replica
+    fleet (``fleet.FleetRouter``: compile-bucket affinity routing,
+    per-replica lanes). Validity folded into ``valid``: every request
+    completes at every width, and aggregate solves/sec is non-decreasing
+    1→3 replicas within the serving noise floor (in-process replicas
+    share one chip, so the claim the gate defends is "replication does
+    not COST throughput" — the scale-out win itself is a multi-host
+    story). A final 2-replica round kills replica 0 mid-stream and
+    reports the journal-handoff latency p99 — the fleet's
+    recovery-time number, regression-gated by ``tools/bench_compare.py``
+    (``fleet-agg-pct``).
+    """
+    import random
+    import tempfile
+
+    from poisson_ellipse_tpu.fleet import FleetRouter
+    from poisson_ellipse_tpu.obs import metrics as obs_metrics
+    from poisson_ellipse_tpu.resilience import faultinject
+
+    def run_stream(replicas: int, kill_at=None):
+        rng = random.Random(seed)
+        faults = []
+        if kill_at is not None:
+            faults.append(faultinject.replica_kill(
+                at_request=kill_at, replica=0,
+            ))
+        with tempfile.TemporaryDirectory() as td:
+            router = FleetRouter(
+                replicas=replicas, journal_dir=td, lanes=lanes,
+                chunk=4, queue_capacity=n_requests + 1,
+                keep_solutions=False, backoff_base_s=0.001,
+                faults=faultinject.FaultPlan(*faults),
+            )
+            t0 = time.perf_counter()
+            for i in range(n_requests):
+                M, N = rng.choice(list(grids))
+                router.submit(Problem(M=M, N=N),
+                              request_id=f"fleet-{i:03d}")
+                router.step()
+            results = router.drain()
+            wall = time.perf_counter() - t0
+        completed = sum(
+            1 for r in results.values() if r.outcome == "completed"
+        )
+        return router, results, completed, wall
+
+    # warm the bucket executables outside every timed round: the lru
+    # cache (serve.scheduler._bucket_advance) is process-wide, so
+    # WITHOUT this the 1-replica round would eat every compile and the
+    # scaling comparison would measure the cache, not the fleet
+    run_stream(1)
+
+    rows = []
+    all_ok = True
+    prev_sps = None
+    non_decreasing = True
+    for replicas in FLEET_REPLICA_COUNTS:
+        _, results, completed, wall = run_stream(replicas)
+        sps = n_requests / wall if wall > 0 else 0.0
+        ok = completed == n_requests and len(results) == n_requests
+        if prev_sps is not None and sps < prev_sps * (
+            1.0 - FLEET_AGG_NOISE_FRAC
+        ):
+            non_decreasing = False
+        all_ok &= ok
+        note(
+            f"  [fleet] {replicas} replica(s) x {lanes} lanes: "
+            f"{n_requests} requests in {wall:.3f}s -> {sps:.2f} "
+            f"solves/s aggregate, completed {completed}/{n_requests} "
+            + ("— OK" if ok else "— INCOMPLETE (regression)"),
+        )
+        rows.append({
+            "replicas": replicas,
+            "lanes": lanes,
+            "solves_per_sec": round(sps, 3),
+            "completed": completed,
+            "wall_s": round(wall, 4),
+        })
+        prev_sps = sps
+    all_ok &= non_decreasing
+
+    # the kill round: handoff latency under a real mid-stream death
+    hist = obs_metrics.REGISTRY.histogram(
+        obs_metrics.HANDOFF_LATENCY_SECONDS
+    )
+    count_before = hist.count
+    router, results, completed, _wall = run_stream(
+        2, kill_at=max(n_requests // 3, 1)
+    )
+    handoff_p99 = hist.quantile(0.99)
+    kill_ok = (
+        completed == n_requests
+        and router.handoffs >= 1
+        and hist.count > count_before
+    )
+    all_ok &= kill_ok
+    note(
+        f"  [fleet] kill drill (2 replicas, kill@{max(n_requests // 3, 1)}): "
+        f"completed {completed}/{n_requests}, "
+        f"{router.handoffs} handoff(s), {router.adopted_total} adopted, "
+        f"handoff p99 {handoff_p99 if handoff_p99 is None else round(handoff_p99, 5)}s "
+        + ("— OK" if kill_ok else "— HANDOFF MISS (regression)"),
+    )
+    row = {
+        "rows": rows,
+        "non_decreasing": non_decreasing,
+        "handoff_p99_s": (
+            round(handoff_p99, 6) if handoff_p99 is not None else None
+        ),
+        "kill_completed": completed,
+        "handoffs": router.handoffs,
+        "adopted": router.adopted_total,
+    }
+    return row, all_ok
+
+
 def bench_collectives():
     """Static collective accounting for the artifact: psum/ppermute per
     iteration read from the jaxpr (``obs.static_cost``) on a 1×2 mesh of
@@ -1065,6 +1200,9 @@ def main() -> int:
     # the continuous-batching front-end: sustained solves/sec + p50/p99
     # under a Poisson arrival stream vs the static-batch baseline
     serve_row, oksv = bench_serving()
+    # the replicated fleet: aggregate solves/sec at 1/2/3 replicas +
+    # journal-handoff latency p99 under a mid-stream replica kill
+    fleet_row, okfl = bench_fleet()
     eps_rows, oke = bench_eps_sweep()
     # observability rows (f32, so they run before the f64 flip below):
     # on-device convergence telemetry + static collective accounting
@@ -1084,8 +1222,8 @@ def main() -> int:
     # and the composite-domain timing row (f32, pre-f64-flip)
     geom_row, okg = bench_geometry()
     all_ok &= (
-        ok2 & okn & ok8 & okp & okpc & okt & okcs & oksv & oke & okc & okl
-        & oks & okr & oka & okg
+        ok2 & okn & ok8 & okp & okpc & okt & okcs & oksv & okfl & oke
+        & okc & okl & oks & okr & oka & okg
     )
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
@@ -1121,6 +1259,11 @@ def main() -> int:
         # latency under a Poisson arrival stream vs static batching
         # (serve.scheduler's retire-and-refill discipline)
         "serving": serve_row,
+        # the replicated fleet: aggregate solves/sec at 1/2/3 replicas
+        # (non-decreasing within the serving noise floor) + journal-
+        # handoff latency p99 under a mid-stream replica kill, gated by
+        # tools/bench_compare.py ([tool.bench_compare] fleet-agg-pct)
+        "fleet": fleet_row,
         "eps_sweep": eps_rows,
         # on-device per-iteration telemetry summary (solve history=True)
         "convergence": conv_row,

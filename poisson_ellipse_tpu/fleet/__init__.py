@@ -1,0 +1,52 @@
+"""fleet — the replicated serving layer (ISSUE 12).
+
+Crash-safety promoted from per-process to fleet-wide: N ``serve``
+scheduler replicas behind a shape-aware, health-checked router, with
+journal-backed request handoff when a replica dies and lease fencing so
+a zombie can never double-complete. Three legs:
+
+- :mod:`.replica` — one ``Scheduler`` + its own crash-safe journal
+  under a **lease** (monotonic-clock heartbeat renewed at chunk
+  boundaries) and a **fencing token** (every journal write validates
+  the epoch; stale writes raise :class:`~.replica.StaleLeaseError`,
+  trace-evented and counted).
+- :mod:`.router` — routing by compile-bucket affinity (the
+  ``runtime.compile_cache`` warm-pool key: requests land where their
+  executable is already warm), per-replica backpressure honored with
+  fleet-minimum ``retry_after_s``, hedging around suspect leases,
+  graceful drain, and the classified
+  ``resilience.errors.FleetUnavailableError`` (exit 9) only when ALL
+  replicas are down.
+- :mod:`.handoff` — a dead replica's journal replayed into survivors'
+  admission: remaining-deadline budgets preserved, backlog waves
+  reused from the single-process replay, zero-lost/zero-double pinned
+  by the fencing order (revoke first, replay second).
+
+The chaos invariants (zero lost / zero double / all classified) extend
+across replica kill, kill-during-handoff and zombie resurrection —
+``serve.chaos.run_chaos(replicas=…)``, ``harness fleet``, and
+``tests/test_fleet.py`` all pin them.
+"""
+
+from poisson_ellipse_tpu.fleet.handoff import handoff_journal
+from poisson_ellipse_tpu.fleet.replica import (
+    DEFAULT_LEASE_S,
+    FenceAuthority,
+    FencingToken,
+    Lease,
+    Replica,
+    StaleLeaseError,
+)
+from poisson_ellipse_tpu.fleet.router import DEFAULT_HEDGE_FRAC, FleetRouter
+
+__all__ = [
+    "DEFAULT_HEDGE_FRAC",
+    "DEFAULT_LEASE_S",
+    "FenceAuthority",
+    "FencingToken",
+    "FleetRouter",
+    "Lease",
+    "Replica",
+    "StaleLeaseError",
+    "handoff_journal",
+]

@@ -1,0 +1,271 @@
+"""One fleet replica: a Scheduler + its own journal under a lease.
+
+The failure model the fleet is built against is the standard distributed
+one: a replica can die (SIGKILL), hang (alive but not making progress),
+or run on a skewed clock — and in every case the rest of the fleet must
+agree on ONE owner per request. Two mechanisms carry that agreement:
+
+- **Lease** — a monotonic-clock heartbeat the replica renews at chunk
+  boundaries (``Replica.step``). A replica that misses its deadline is
+  *declared dead by the router* (``fleet.router``); the replica itself
+  never gets a vote, because a hung process cannot be trusted to report
+  its own hang. Wall-clock leases are a bug class of their own (an NTP
+  step makes them fire early or never — tpulint TPU016 fences the
+  pattern), so every lease arithmetic here is ``clock()`` =
+  ``time.monotonic`` by default.
+
+- **Fencing token** — an epoch issued by the fleet's
+  :class:`FenceAuthority` when the replica is born and revoked the
+  instant it is declared dead. The replica's journal carries the token
+  (``serve.journal.RequestJournal(fence=...)``): every journal write
+  validates it first and every snapshot embeds it, so a zombie — a
+  replica whose lease expired while its process lived — that resurrects
+  mid-handoff and tries to admit or complete a request hits
+  :class:`StaleLeaseError` at the journal, before anything lands in
+  memory or on disk. Zero-double is enforced where the record lives,
+  not asserted after the fact (the ``serve.journal`` stance, promoted
+  fleet-wide).
+
+The replica's scheduler is the unmodified ``serve.Scheduler`` — same
+admission, same retry ladder, same chunk-boundary retire/refill. The
+fleet wraps it; it does not fork it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from poisson_ellipse_tpu.obs import metrics as obs_metrics
+from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.serve.journal import RequestJournal
+from poisson_ellipse_tpu.serve.scheduler import Scheduler
+
+DEFAULT_LEASE_S = 0.5
+
+
+def routing_load_key(rep: "Replica", affinity_key) -> tuple:
+    """The fleet's shared routing order (router admission AND handoff
+    adoption): replicas with free lanes first (load quantized by lane
+    width), warm compile-bucket affinity within a load class, then raw
+    load, then id for determinism. Quantizing load by lanes is what
+    keeps affinity from defeating scaling — a warm replica wins ties,
+    but a replica with free lanes always beats a saturated warm one."""
+    load = rep.queue_depth() + rep.in_flight()
+    lanes = max(rep.scheduler.lanes, 1)
+    return (
+        load // lanes,
+        0 if affinity_key in rep.warm_keys() else 1,
+        load,
+        rep.replica_id,
+    )
+
+
+class StaleLeaseError(RuntimeError):
+    """A fenced (revoked) token tried to write: the zombie-resurrection
+    bug class — a replica declared dead coming back mid-handoff and
+    double-completing a request a survivor now owns. Raised by
+    :meth:`FencingToken.check` at the journal choke point, trace-evented
+    (``fleet:stale-write-rejected``) and counted
+    (``fleet_stale_writes_total``) so the drill is observable, not
+    silent."""
+
+
+class FenceAuthority:
+    """The fleet's epoch registry: one current epoch per replica id.
+
+    Stands in for the lease service a multi-host deployment would put
+    in a shared store (etcd/Chubby-shaped); in-process the semantics are
+    identical — :meth:`issue` mints a token at a fresh epoch,
+    :meth:`fence` advances the epoch so every outstanding token goes
+    stale atomically, and :meth:`valid` is the single comparison every
+    fenced write reduces to."""
+
+    def __init__(self):
+        self._epoch: dict[int, int] = {}
+
+    def issue(self, replica_id: int) -> "FencingToken":
+        """Mint the replica's token at a fresh epoch (re-issuing — a
+        restarted replica under the same id — bumps the epoch, so the
+        dead incarnation's token is stale from the first write)."""
+        self._epoch[replica_id] = self._epoch.get(replica_id, 0) + 1
+        return FencingToken(self, replica_id, self._epoch[replica_id])
+
+    def fence(self, replica_id: int) -> None:
+        """Revoke every outstanding token of ``replica_id`` (declared
+        dead): the epoch advances, so the zombie's next fenced write
+        raises instead of landing."""
+        self._epoch[replica_id] = self._epoch.get(replica_id, 0) + 1
+
+    def valid(self, replica_id: int, epoch: int) -> bool:
+        return self._epoch.get(replica_id) == epoch
+
+
+class FencingToken:
+    """One replica incarnation's write credential: ``(replica, epoch)``.
+
+    ``value`` is the string every journal snapshot embeds;
+    :meth:`check` is the gate every journal mutation calls first."""
+
+    __slots__ = ("authority", "replica_id", "epoch")
+
+    def __init__(self, authority: FenceAuthority, replica_id: int,
+                 epoch: int):
+        self.authority = authority
+        self.replica_id = replica_id
+        self.epoch = epoch
+
+    @property
+    def value(self) -> str:
+        return f"r{self.replica_id}:e{self.epoch}"
+
+    @property
+    def stale(self) -> bool:
+        return not self.authority.valid(self.replica_id, self.epoch)
+
+    def check(self) -> None:
+        """Raise :class:`StaleLeaseError` (trace-evented, counted) when
+        the token has been fenced — the zero-double choke point."""
+        if self.stale:
+            obs_trace.event(
+                "fleet:stale-write-rejected",
+                replica=self.replica_id,
+                token=self.value,
+            )
+            obs_metrics.counter(
+                obs_metrics.FLEET_STALE_WRITES_TOTAL
+            ).inc()
+            raise StaleLeaseError(
+                f"fencing token {self.value} is stale: replica "
+                f"{self.replica_id} was declared dead and fenced; this "
+                "write belongs to a zombie and is rejected"
+            )
+
+
+class Lease:
+    """A monotonic-clock lease: ``renew()`` pushes the deadline
+    ``lease_s`` ahead of now; a missed renewal lets ``expired(now)``
+    trip under the ROUTER's clock. ``skew_s`` injects the NTP-step
+    drill (``faultinject.lease_clock_skew``): the replica's renewals
+    are computed on a clock ``skew_s`` behind the router's, so a skew
+    past the lease length makes a live replica read as dead — the
+    router must fence it rather than share ownership."""
+
+    def __init__(self, clock: Callable[[], float], lease_s: float):
+        if lease_s <= 0:
+            raise ValueError("lease_s must be > 0")
+        self.clock = clock
+        self.lease_s = lease_s
+        self.skew_s = 0.0
+        self.deadline = clock() + lease_s
+
+    def renew(self) -> None:
+        self.deadline = self.clock() - self.skew_s + self.lease_s
+
+    def remaining(self, now: float) -> float:
+        return self.deadline - now
+
+    def expired(self, now: float) -> bool:
+        return now > self.deadline
+
+
+class Replica:
+    """One scheduler replica of the fleet: ``Scheduler`` + fenced
+    journal + lease, plus the drain/handoff surface the router drives.
+
+    ``journal_path`` is this replica's own ledger (one file per
+    replica: a fleet shares NO mutable state except the fence
+    authority, which stands in for the shared lease store).
+    ``scheduler_kw`` passes through to ``serve.Scheduler`` untouched.
+    """
+
+    def __init__(
+        self,
+        replica_id: int,
+        journal_path,
+        authority: FenceAuthority,
+        clock: Callable[[], float] = time.monotonic,
+        lease_s: float = DEFAULT_LEASE_S,
+        **scheduler_kw,
+    ):
+        self.replica_id = replica_id
+        self.journal_path = journal_path
+        self.authority = authority
+        self.clock = clock
+        self.token = authority.issue(replica_id)
+        self.lease = Lease(clock, lease_s)
+        self.scheduler = Scheduler(
+            journal=RequestJournal(journal_path, fence=self.token),
+            clock=clock,
+            **scheduler_kw,
+        )
+        # a hang fault parks the heartbeat until this instant while the
+        # process object lives — the zombie drill's arming state
+        self.hung_until: float = 0.0
+        self.dead = False
+
+    # -- the router-facing surface ------------------------------------------
+
+    @property
+    def live(self) -> bool:
+        return not self.dead
+
+    @property
+    def draining(self) -> bool:
+        return self.scheduler.draining
+
+    def queue_depth(self) -> int:
+        return len(self.scheduler.queue) + len(
+            self.scheduler._replay_backlog
+        )
+
+    def in_flight(self) -> int:
+        return sum(
+            1
+            for ctx in self.scheduler._ctxs.values()
+            for slot in ctx.slots
+            if slot is not None
+        )
+
+    def warm_keys(self) -> frozenset:
+        """The compile-bucket keys this replica holds LIVE batch
+        contexts for — ``runtime.compile_cache.warm_affinity_key``'s
+        ``(grid_bucket, norm)`` spelling, which is exactly the
+        scheduler's ``_ctxs`` key. The router's affinity signal."""
+        return frozenset(self.scheduler._ctxs.keys())
+
+    def hung(self, now: float) -> bool:
+        return now < self.hung_until
+
+    def step(self, now: Optional[float] = None) -> bool:
+        """One chunk boundary: advance the scheduler (a hung or dead
+        replica does nothing). The lease renewal is NOT here — it is
+        the router's post-step sweep (``FleetRouter.step``), the one
+        authoritative site, stamped AFTER the work so the heartbeat
+        means "made progress", not "was about to"; a scheduler wedged
+        inside a dispatch never reaches the sweep and stops
+        heartbeating, which is the property the lease exists for."""
+        now = self.clock() if now is None else now
+        if self.dead or self.hung(now):
+            return False
+        return self.scheduler.step()
+
+    def resurrect_step(self) -> bool:
+        """What a ZOMBIE's own serve loop does when the hang clears: it
+        does not know the router declared it dead, so it steps its
+        scheduler directly — and the moment a lane retires, the fenced
+        journal raises :class:`StaleLeaseError` before the completion
+        can land anywhere. The drill entry (``serve.chaos`` /
+        ``tests/test_fleet.py``); the router never calls this."""
+        return self.scheduler.step()
+
+    def begin_drain(self) -> None:
+        self.scheduler.begin_drain()
+
+    def publish_metrics(self) -> None:
+        obs_metrics.replica_gauge("fleet_queue_depth", self.replica_id).set(
+            self.queue_depth()
+        )
+        obs_metrics.replica_gauge("fleet_in_flight", self.replica_id).set(
+            self.in_flight()
+        )

@@ -1,0 +1,517 @@
+"""Shape-aware, health-checked routing over N scheduler replicas.
+
+The router is the fleet's front door and its failure detector in one
+loop — the crash-safety ladder, in the order a request experiences it:
+
+1. **Route warm** — admission prefers a live replica already holding the
+   request's compile bucket (``runtime.compile_cache.warm_affinity_key``
+   == the scheduler's batch-context key), then least-loaded. A request
+   lands where its executable is warm; only a cold bucket pays a
+   compile, and only once per fleet, not once per replica.
+2. **Honor backpressure** — a replica that sheds (queue full, draining,
+   infeasible deadline) answers with ``retry_after_s``; the router
+   tries the next candidate and only returns a terminal shed (with the
+   MINIMUM retry hint — the soonest anyone frees up) when every live
+   replica refused.
+3. **Hedge around suspects** — a replica whose lease is inside the
+   hedge margin of expiry is *suspected*: new requests route around it
+   (``fleet:hedge`` trace event) rather than queue behind a process
+   that is probably dying. Suspicion is cheap and reversible; death is
+   neither, so the thresholds differ.
+4. **Declare, fence, hand off** — a replica that misses its lease
+   deadline is declared dead under the ROUTER's monotonic clock: its
+   fencing token is revoked FIRST (zombie writes now rejected), then
+   its journal replays into the survivors (``fleet.handoff``) with
+   remaining-deadline budgets preserved. Queued and in-flight requests
+   re-enter exactly once; completed ones were compacted and do not.
+5. **Classify total loss** — with zero live, non-draining replicas the
+   router raises ``FleetUnavailableError`` (exit 9, carrying a
+   ``retry_after_s`` hint) instead of hanging a request on a queue
+   nobody will drain. One replica down is routine; all replicas down is
+   loud.
+
+Drain (``shutdown()``) is the graceful inverse: every replica stops
+admitting (``Scheduler.begin_drain``), finishes what it owns, flushes
+metrics — the SIGTERM path of ``harness serve``/``harness fleet`` rides
+this hook.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from poisson_ellipse_tpu.fleet.handoff import handoff_journal
+from poisson_ellipse_tpu.fleet.replica import (
+    DEFAULT_LEASE_S,
+    FenceAuthority,
+    Replica,
+    routing_load_key,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import metrics as obs_metrics
+from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.resilience.errors import FleetUnavailableError
+from poisson_ellipse_tpu.resilience.faultinject import (
+    REPLICA_KINDS,
+    FaultPlan,
+)
+from poisson_ellipse_tpu.runtime.compile_cache import warm_affinity_key
+from poisson_ellipse_tpu.serve.request import (
+    ServeRequest,
+    ServeResult,
+    new_request_id,
+)
+
+# fraction of the lease length left below which a replica is SUSPECTED
+# (new requests hedge around it); 0 disables hedging
+DEFAULT_HEDGE_FRAC = 0.25
+
+
+class FleetRouter:
+    """N replicas behind one admission surface (see module docstring).
+
+    ``journal_dir`` holds one ledger per replica
+    (``replica-<i>.journal``); ``clock`` must be monotonic (injectable
+    for deterministic lease tests); ``faults`` takes replica-addressed
+    injections (``faultinject.replica_kill/replica_hang/
+    lease_clock_skew``) consulted at arrival boundaries.
+    ``scheduler_kw`` passes through to every replica's Scheduler.
+    """
+
+    def __init__(
+        self,
+        replicas: int = 2,
+        journal_dir=None,
+        clock: Callable[[], float] = time.monotonic,
+        idle: Callable[[float], None] = time.sleep,
+        lease_s: float = DEFAULT_LEASE_S,
+        hedge_frac: float = DEFAULT_HEDGE_FRAC,
+        faults: Optional[FaultPlan] = None,
+        **scheduler_kw,
+    ):
+        import os
+
+        if replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        if journal_dir is None:
+            raise ValueError(
+                "journal_dir is required: the fleet's crash-safety story "
+                "IS the per-replica journals"
+            )
+        os.makedirs(os.fspath(journal_dir), exist_ok=True)
+        self.clock = clock
+        self.idle = idle
+        self.lease_s = lease_s
+        self.hedge_frac = hedge_frac
+        self.faults = faults if faults is not None else FaultPlan()
+        self.authority = FenceAuthority()
+        self.replicas: list[Replica] = [
+            Replica(
+                i,
+                os.path.join(os.fspath(journal_dir), f"replica-{i}.journal"),
+                self.authority,
+                clock=clock,
+                lease_s=lease_s,
+                # ONE plan, fleet-wide: the router consults its
+                # replica-level kinds, every scheduler the
+                # request-addressed ones — so a nan/oom fault fires on
+                # whichever replica hosts its victim, exactly once
+                faults=self.faults,
+                **scheduler_kw,
+            )
+            for i in range(replicas)
+        ]
+        # router-level terminal records: all-replicas-shed rejections
+        # land here (replica results are harvested via collect())
+        self.results: dict[str, ServeResult] = {}
+        self._arrivals = 0
+        self.handoffs = 0
+        self.adopted_total = 0
+        self.zombies: dict[int, Replica] = {}
+        # the fleet-wide exactly-once ledger: every DELIVERED terminal
+        # record's id (replica collect()s evict, so each record passes
+        # harvest exactly once) — a second delivery for an id is the
+        # double-completion bug class the fencing exists to prevent,
+        # recorded here as hard evidence instead of being silently
+        # last-writer-overwritten in the results dict
+        self._delivered_ids: set[str] = set()
+        self.double_delivered: list[str] = []
+
+    # -- liveness ------------------------------------------------------------
+
+    def live_replicas(self) -> list[Replica]:
+        return [r for r in self.replicas if r.live]
+
+    def _admitting(self) -> list[Replica]:
+        return [r for r in self.replicas if r.live and not r.draining]
+
+    def _suspect(self, rep: Replica, now: float) -> bool:
+        return rep.lease.remaining(now) < self.hedge_frac * self.lease_s
+
+    def check_leases(self) -> list[int]:
+        """Declare every lease-expired replica dead (fence, then hand
+        off) under the router's clock. Returns the ids declared this
+        call. The order is the fencing contract: the token is revoked
+        BEFORE the journal replay starts, so there is no window in
+        which the zombie and a survivor both own a request."""
+        now = self.clock()
+        declared = []
+        for rep in self.replicas:
+            if rep.live and rep.lease.expired(now):
+                obs_trace.event(
+                    "fleet:lease-expired",
+                    replica=rep.replica_id,
+                    overdue_s=round(now - rep.lease.deadline, 6),
+                )
+                obs_metrics.counter(
+                    obs_metrics.LEASE_EXPIRY_TOTAL
+                ).inc()
+                self._declare_dead(rep, cause="lease-expired",
+                                   zombie=True)
+                declared.append(rep.replica_id)
+        return declared
+
+    def kill_replica(self, replica_id: int) -> None:
+        """SIGKILL semantics: harvest what the dead replica already
+        delivered (its journal compacted those), drop it, fence it,
+        hand its journal off. The chaos drill's kill entry."""
+        rep = self._by_id(replica_id)
+        if rep is None or not rep.live:
+            return
+        obs_trace.event("fleet:replica-kill", replica=replica_id)
+        self._declare_dead(rep, cause="killed", zombie=False)
+
+    def _by_id(self, replica_id: int) -> Optional[Replica]:
+        for rep in self.replicas:
+            if rep.replica_id == replica_id:
+                return rep
+        return None
+
+    def _declare_dead(self, rep: Replica, cause: str,
+                      zombie: bool) -> None:
+        # 1. harvest results the dead replica already delivered — the
+        #    journal compacted them, so the handoff below cannot replay
+        #    them; dropping them here would read as lost. Through the
+        #    delivery LEDGER (_deliver), not a raw dict update: a
+        #    record delivered here and again by a survivor is the
+        #    double-completion evidence the ledger exists to keep
+        for rid, res in rep.scheduler.collect().items():
+            self._deliver(rid, res, rep.replica_id)
+        # 2. fence FIRST: from this instant the (possible) zombie's
+        #    journal writes raise, so the survivors own the requests
+        #    exclusively before any of them is re-admitted
+        self.authority.fence(rep.replica_id)
+        rep.dead = True
+        if zombie:
+            # the process object lives on (lease expiry, not SIGKILL):
+            # keep it addressable for the resurrection drill
+            self.zombies[rep.replica_id] = rep
+        # 3. hand off the journal to the survivors — every LIVE replica
+        #    is a candidate (handoff.py prefers non-draining ones but
+        #    falls back to draining: already-acknowledged fleet work is
+        #    not a new admission, and a draining replica finishes what
+        #    it owns before exiting)
+        survivors = [r for r in self.replicas if r.live]
+        adopted, abandoned = handoff_journal(
+            rep.journal_path, survivors, clock=self.clock,
+            dead_replica=rep.replica_id,
+        )
+        if adopted > 0:
+            # only a sweep that moved work counts: "handoffs >= 1"
+            # gates must never be satisfiable by an empty or
+            # abandoning no-op
+            self.handoffs += 1
+        self.adopted_total += adopted
+        # the dead replica's gauges would otherwise freeze at their
+        # last published values — phantom backlog on a replica that no
+        # longer exists, contradicting the handoff that just moved it
+        obs_metrics.replica_gauge(
+            "fleet_queue_depth", rep.replica_id
+        ).set(0)
+        obs_metrics.replica_gauge(
+            "fleet_in_flight", rep.replica_id
+        ).set(0)
+        obs_trace.event(
+            "fleet:replica-dead",
+            replica=rep.replica_id,
+            cause=cause,
+            adopted_by_survivors=adopted,
+            abandoned=abandoned,
+            survivors=[s.replica_id for s in survivors],
+        )
+
+    # -- replica-addressed fault injection -----------------------------------
+
+    def _apply_replica_faults(self, arrival_index: int) -> None:
+        """Fire replica faults whose 0-based ``at_request`` has landed:
+        ``arrival_index`` is the request arriving NOW during a submit
+        (so ``at_request=8`` fires exactly as ``chaos-0008`` arrives,
+        before it is routed — matching the 0-based id scheme
+        everywhere else), or the last-landed index between arrivals
+        (router steps never fire a fault early)."""
+        for fault in self.faults.faults:
+            if (fault.fired or fault.kind not in REPLICA_KINDS
+                    or arrival_index < fault.at_request):
+                continue
+            fault.fired = True
+            obs_trace.event(
+                "fleet:fault", kind=fault.kind, replica=fault.replica,
+                at_request=fault.at_request,
+            )
+            rep = self._by_id(fault.replica)
+            if fault.kind == "replica_kill":
+                self.kill_replica(fault.replica)
+            elif fault.kind == "replica_hang" and rep is not None:
+                rep.hung_until = self.clock() + fault.delay_s
+            elif fault.kind == "lease_clock_skew" and rep is not None:
+                rep.lease.skew_s = fault.skew_s
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, problem: Problem, deadline_s: float | None = None,
+               max_retries: int | None = None,
+               request_id: str | None = None) -> Optional[ServeResult]:
+        """Route one request (same surface as ``Scheduler.submit``).
+
+        Returns ``None`` on acceptance, the terminal shed when EVERY
+        live replica refused (minimum ``retry_after_s``), and raises
+        :class:`FleetUnavailableError` when no replica can admit at
+        all — loud, classified, never a hang."""
+        self._apply_replica_faults(self._arrivals)
+        self._arrivals += 1
+        self.check_leases()
+        now = self.clock()
+        if request_id is not None and self._knows(request_id):
+            # the scheduler's duplicate-id door, fleet-wide: one id, one
+            # owner — a resubmission must not fork the request onto a
+            # second replica (it would double-complete by construction)
+            return ServeResult(
+                request_id=request_id, outcome="shed",
+                detail="duplicate-request-id",
+            )
+        candidates = self._admitting()
+        if not candidates:
+            raise FleetUnavailableError(
+                "every fleet replica is dead or draining: no admission "
+                "path remains (resubmit once a replica rejoins)",
+                retry_after_s=self.lease_s,
+            )
+        key = warm_affinity_key(problem.M, problem.N, problem.norm)
+        healthy = [r for r in candidates if not self._suspect(r, now)]
+        hedged = healthy if healthy else candidates
+        if healthy and len(healthy) < len(candidates):
+            # at least one candidate was routed AROUND on suspicion —
+            # the hedge: don't queue new work behind a probably-dying
+            # replica that has not yet missed its deadline
+            obs_trace.event(
+                "fleet:hedge",
+                suspected=[
+                    r.replica_id for r in candidates if r not in healthy
+                ],
+            )
+        order = sorted(hedged, key=lambda r: routing_load_key(r, key))
+        # one concrete id per LOGICAL request, minted here when the
+        # caller brought none: every candidate probe runs under it, so
+        # a rejected probe's record can be erased by name and the
+        # terminal all-shed below is recorded under a real id instead
+        # of one phantom uuid per replica probed
+        rid = request_id if request_id is not None else new_request_id()
+        retry_hints = []
+        for rep in order:
+            shed = rep.scheduler.submit(
+                problem, deadline_s=deadline_s, max_retries=max_retries,
+                request_id=rid,
+            )
+            if shed is None:
+                obs_trace.event(
+                    "fleet:route",
+                    replica=rep.replica_id,
+                    warm=key in rep.warm_keys(),
+                )
+                return None
+            if shed.outcome != "shed" or shed.detail == "duplicate-request-id":
+                # a terminal classification (invalid geometry, duplicate
+                # id) is the request's answer, not backpressure — it
+                # must not be retried onto another replica
+                return shed
+            # the probe's rejection is the ROUTER's redirect, not this
+            # replica's lifecycle event: erase the scheduler-side
+            # record so harvest() can never merge a stale shed over the
+            # completion another replica is about to deliver (nothing
+            # was journaled or queued — sheds are rejected pre-durable)
+            rep.scheduler.results.pop(rid, None)
+            if shed.retry_after_s is not None:
+                retry_hints.append(shed.retry_after_s)
+        retry_after = min(retry_hints) if retry_hints else None
+        result = ServeResult(
+            request_id=rid,
+            outcome="shed",
+            detail="fleet-backpressure",
+            retry_after_s=retry_after,
+        )
+        # the one authoritative terminal record of the rejection —
+        # counted once fleet-wide, whoever minted the id
+        self.results[rid] = result
+        obs_trace.event(
+            "fleet:shed-all-replicas",
+            request_id=rid,
+            retry_after_s=retry_after,
+        )
+        return result
+
+    def _knows(self, request_id: str) -> bool:
+        """Fleet-wide ownership of an id — DEAD replicas included: a
+        since-killed replica's in-memory journal still remembers what
+        it finished (its on-disk snapshot compacted the ids away), and
+        that memory is what stops an ordinary client retry of an
+        already-delivered request from double-completing on a survivor.
+        A recorded fleet-backpressure shed that never dispatched is NOT
+        ownership (the outcome table's safe-to-resubmit promise — the
+        scheduler-level carve-out, applied at the router's door too)."""
+        prior = self.results.get(request_id)
+        if (prior is not None and prior.outcome == "shed"
+                and not prior.dispatched):
+            del self.results[request_id]
+        elif prior is not None:
+            return True
+        return any(
+            rep.scheduler.owns_request(request_id)
+            for rep in self.replicas
+        )
+
+    # -- the fleet loop ------------------------------------------------------
+
+    def step(self) -> bool:
+        """One boundary across the fleet: fire due replica faults,
+        check leases (dead replicas fence + hand off), advance every
+        live replica, publish per-replica gauges. Returns True while
+        any replica still holds work.
+
+        Lease renewals happen in a SWEEP after all stepping: in this
+        in-process simulation the replicas run sequentially, so a slow
+        boundary on one (a fresh bucket's compile) must not eat into a
+        peer's lease window — the sweep stamps every live, non-hung
+        replica at the same instant, exactly as concurrent heartbeats
+        would. A hung replica skips the sweep, which is what lets its
+        lease expire while the process lives (the zombie drill)."""
+        self._apply_replica_faults(self._arrivals - 1)
+        self.check_leases()
+        working = False
+        for rep in self.live_replicas():
+            working = rep.step() or working
+            rep.publish_metrics()
+        now = self.clock()
+        for rep in self.live_replicas():
+            if not rep.hung(now):
+                rep.lease.renew()
+        return working
+
+    def drain(self, max_steps: int = 100_000) -> dict[str, ServeResult]:
+        """Step until every admitted request is terminal fleet-wide.
+
+        A fleet left with work but zero live replicas raises
+        ``FleetUnavailableError`` — the classified exit-9 contract —
+        instead of spinning on a queue nobody owns."""
+        steps = 0
+        while True:
+            working = self.step()
+            self.harvest()
+            if not working and not any(
+                r.queue_depth() or r.in_flight()
+                for r in self.live_replicas()
+            ):
+                if not self.live_replicas() and self._pending_anywhere():
+                    raise FleetUnavailableError(
+                        "every replica died with requests still "
+                        "admitted: nothing can drain them (exit 9)"
+                    )
+                return dict(self.results)
+            if working and not any(
+                r.in_flight() for r in self.live_replicas()
+            ):
+                # only backoff-parked retries remain: wait the soonest
+                # one out instead of spinning (Scheduler.drain's idle
+                # contract, fleet-wide)
+                now = self.clock()
+                waits = [
+                    w
+                    for rep in self.live_replicas()
+                    for w in (rep.scheduler.queue.next_ready_in(now),)
+                    if w is not None
+                ]
+                if waits:
+                    self.idle(min(waits))
+            elif not working:
+                # the only replicas holding work are HUNG (a stepped
+                # scheduler with work reports working): nothing to do
+                # but let their leases run down — idle in lease
+                # fractions instead of hot-spinning into max_steps
+                # before the expiry can even land (the TPU014 stance)
+                self.idle(self.lease_s / 10)
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"fleet drain exceeded {max_steps} steps"
+                )
+
+    def _pending_anywhere(self) -> bool:
+        return any(
+            r.queue_depth() or r.in_flight() for r in self.replicas
+        )
+
+    def harvest(self) -> dict[str, ServeResult]:
+        """Merge every replica's delivered results (the zombies'
+        PRE-fence ones included — their journals compacted those) into
+        the router's buffer; returns the buffer.
+
+        Each scheduler ``collect()`` EVICTS, so every terminal record
+        passes through the ledger (``_deliver``) exactly once — which
+        makes a second delivery for an id the double-completion bug
+        class itself, not a merge artifact: it is appended to
+        ``double_delivered`` (the chaos report's zero-double evidence)
+        and trace-evented, never silently last-writer-overwritten."""
+        for rep in self.replicas:
+            for rid, res in rep.scheduler.collect().items():
+                self._deliver(rid, res, rep.replica_id)
+        return self.results
+
+    def _deliver(self, rid: str, res: ServeResult,
+                 replica_id: int) -> None:
+        """The fleet's exactly-once delivery ledger: EVERY terminal
+        record a replica hands up (steady-state harvest AND the
+        declare-dead sweep) passes here once."""
+        if rid in self._delivered_ids:
+            self.double_delivered.append(rid)
+            # windowed bound: evidence of a bug, not a log
+            del self.double_delivered[:-1024]
+            obs_trace.event(
+                "fleet:double-delivery", request_id=rid,
+                replica=replica_id, outcome=res.outcome,
+            )
+        self._delivered_ids.add(rid)
+        self.results[rid] = res
+
+    def collect(self) -> dict[str, ServeResult]:
+        """Hand off and evict the merged results (the
+        ``Scheduler.collect`` contract, fleet-wide)."""
+        self.harvest()
+        out = self.results
+        self.results = {}
+        return out
+
+    # -- drain / shutdown ----------------------------------------------------
+
+    def shutdown(self) -> dict[str, ServeResult]:
+        """Graceful fleet drain: every replica stops admitting, finishes
+        what it owns, and the merged results come back — the SIGTERM
+        path. New submissions during shutdown shed with
+        ``retry_after_s`` (or raise exit 9 once every replica drains to
+        a stop)."""
+        for rep in self.live_replicas():
+            rep.begin_drain()
+        obs_trace.event(
+            "fleet:drain",
+            replicas=[r.replica_id for r in self.live_replicas()],
+        )
+        return self.drain()

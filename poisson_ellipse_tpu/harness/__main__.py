@@ -51,6 +51,14 @@ The serving surface:
   kill/restart with journal replay, asserting zero lost / zero
   double-completed / all outcomes classified — ``python -m
   poisson_ellipse_tpu.harness chaos --requests 50 --seed 0``.
+- ``fleet`` is the replicated-serving drill (``fleet.FleetRouter``):
+  the stream routed over ``--replicas`` scheduler replicas by
+  compile-bucket affinity, with lease health checks and
+  ``--kill-replica-at`` arming a mid-stream SIGKILL whose journal
+  hands off to the survivors — ``python -m poisson_ellipse_tpu.harness
+  fleet --replicas 3 --requests 24 --kill-replica-at 8``. SIGTERM
+  drains ``serve``/``fleet`` gracefully: stop admitting, finish
+  in-flight, flush the trace, exit 0.
 
 And the resilience surface:
 
@@ -69,7 +77,8 @@ And the resilience surface:
   admission by the serving layer (backpressure; retry after the hint),
   8 geometry rejected by the admissibility gate (``--geometry`` with a
   malformed/empty/under-resolved spec or an inadmissible operator —
-  classified before any device dispatch).
+  classified before any device dispatch), 9 every fleet replica down
+  or draining (``FleetUnavailableError`` — no admission path left).
 """
 
 from __future__ import annotations
@@ -104,8 +113,47 @@ EXIT_CODES_HELP = (
     "device lost with no degraded mesh left to resume on; 8 geometry "
     "rejected by the admissibility gate (malformed/empty/under-resolved "
     "spec or inadmissible operator — classified BEFORE any device "
-    "dispatch)."
+    "dispatch); 9 every serving-fleet replica down or draining — no "
+    "admission path left (FleetUnavailableError; resubmit after "
+    "retry_after_s once a replica rejoins)."
 )
+
+
+class _SigtermDrain:
+    """SIGTERM → graceful drain for the serving subcommands.
+
+    The handler only sets a flag; the serve loop checks it between
+    arrivals and switches to drain mode (stop admitting, finish or
+    journal in-flight, flush metrics/trace, exit 0) instead of dying
+    mid-stream with the trace tail unflushed. Installed around the
+    loop and restored on exit; a non-main-thread caller (tests driving
+    ``main()`` from a worker) simply gets no handler, never an error.
+    """
+
+    def __init__(self):
+        self.requested = False
+        self._prev = None
+        self._installed = False
+
+    def _handle(self, signum, frame):
+        self.requested = True
+
+    def __enter__(self):
+        import signal
+
+        try:
+            self._prev = signal.signal(signal.SIGTERM, self._handle)
+            self._installed = True
+        except ValueError:  # not the main thread: no handler, no error
+            pass
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._prev)
+        return False
 
 
 def _parse_grid(spec: str | None, default=(40, 40)) -> tuple[int, int]:
@@ -754,16 +802,28 @@ def _run_serve(argv: list[str]) -> int:
         # the eviction hand-off a long-lived server needs (the
         # scheduler's buffer stays bounded by the in-flight window)
         results: dict = {}
-        for _ in range(args.requests):
-            M, N = rng.choice(grids)
-            sched.submit(
-                Problem(M=M, N=N), deadline_s=args.deadline,
-            )
-            _time.sleep(min(rng.expovariate(args.rate), 0.05))
-            sched.step()
+        drained_early = False
+        with _SigtermDrain() as term:
+            for _ in range(args.requests):
+                if term.requested:
+                    # SIGTERM: stop admitting, finish (or journal) the
+                    # in-flight work, flush, exit 0 — the trace tail
+                    # survives the shutdown instead of dying with it
+                    drained_early = True
+                    sched.begin_drain()
+                    obs_trace.event(
+                        "serve:sigterm-drain", queued=len(sched.queue),
+                    )
+                    break
+                M, N = rng.choice(grids)
+                sched.submit(
+                    Problem(M=M, N=N), deadline_s=args.deadline,
+                )
+                _time.sleep(min(rng.expovariate(args.rate), 0.05))
+                sched.step()
+                results.update(sched.collect())
+            sched.drain()
             results.update(sched.collect())
-        sched.drain()
-        results.update(sched.collect())
         wall = _time.monotonic() - t0
         counts: dict[str, int] = {}
         for res in results.values():
@@ -778,6 +838,7 @@ def _run_serve(argv: list[str]) -> int:
             "queue_p50_s": lat.quantile(0.5),
             "queue_p99_s": lat.quantile(0.99),
             "wall_s": round(wall, 4),
+            "drained_on_sigterm": drained_early,
         }
         obs_trace.event("serve_report", **record)
         if args.metrics:
@@ -803,9 +864,13 @@ def _run_serve(argv: list[str]) -> int:
         # the documented contract: exit with the worst (numerically
         # highest) per-request outcome, so a gate scripting on the
         # help text classifies deadline misses and sheds as themselves
-        # rather than as convergence failures
+        # rather than as convergence failures. A SIGTERM'd run that
+        # drained cleanly exits 0 — graceful shutdown is a success,
+        # not the worst outcome of a stream it cut short.
         from poisson_ellipse_tpu.serve import EXIT_BY_OUTCOME
 
+        if drained_early:
+            return 0
         return max((EXIT_BY_OUTCOME[o] for o in counts), default=0)
     finally:
         obs_metrics.REGISTRY.emit()
@@ -916,6 +981,185 @@ def _run_chaos(argv: list[str]) -> int:
             obs_trace.stop()
 
 
+def _run_fleet(argv: list[str]) -> int:
+    """The ``fleet`` subcommand: an N-replica Poisson drill through the
+    replicated serving layer (``fleet.FleetRouter``) — shape-affinity
+    routing, lease-checked replicas, optional mid-stream replica kill
+    with journal-backed handoff, SIGTERM-graceful drain."""
+    import random
+    import tempfile
+    import time as _time
+
+    from poisson_ellipse_tpu.fleet import FleetRouter
+    from poisson_ellipse_tpu.resilience import faultinject
+    from poisson_ellipse_tpu.resilience.errors import FleetUnavailableError
+    from poisson_ellipse_tpu.serve import EXIT_BY_OUTCOME
+
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness fleet",
+        description="Replicated-serving drill: a seeded Poisson stream "
+        "of mixed shapes routed over N scheduler replicas "
+        "(compile-bucket affinity, per-replica backpressure, lease "
+        "health checks). --kill-replica-at SIGKILLs replica 0 at that "
+        "arrival index: its journal hands off to the survivors with "
+        "remaining-deadline budgets preserved, and the stream "
+        "continues. SIGTERM drains gracefully (stop admitting, finish "
+        "in-flight, flush, exit 0). exit code = the worst per-request "
+        "outcome; 9 when every replica is down "
+        "(FleetUnavailableError). " + EXIT_CODES_HELP,
+    )
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument(
+        "--kill-replica-at", type=int, default=None, metavar="INDEX",
+        help="SIGKILL replica 0 when arrival INDEX lands (journal "
+        "handoff drill); default: no kill",
+    )
+    ap.add_argument("--grids", default="10x10,12x12")
+    ap.add_argument("--rate", type=float, default=200.0)
+    ap.add_argument("--lanes", type=int, default=2,
+                    help="lanes per replica")
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--deadline", type=float, default=None,
+                    metavar="SECONDS")
+    ap.add_argument("--retries", type=int, default=1)
+    ap.add_argument(
+        "--journal-dir", metavar="DIR",
+        help="fleet journal directory, one ledger per replica "
+        "(default: a temp dir, removed after)",
+    )
+    ap.add_argument(
+        "--lease", type=float, default=0.5, metavar="SECONDS",
+        help="replica lease length (monotonic-clock heartbeat)",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
+    ap.add_argument(
+        "--metrics", metavar="FILE",
+        help="OpenMetrics snapshot of the fleet counters/histograms",
+    )
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        obs_trace.start(args.trace)
+    tmp_dir = None
+    try:
+        try:
+            grids = [_parse_grid(spec) for spec in args.grids.split(",")]
+            if args.replicas < 1:
+                raise ValueError("--replicas must be >= 1")
+            if args.requests < 1:
+                raise ValueError("--requests must be >= 1")
+            if args.rate <= 0:
+                raise ValueError("--rate must be > 0 requests/second")
+            journal_dir = args.journal_dir
+            if journal_dir is None:
+                tmp_dir = tempfile.TemporaryDirectory()
+                journal_dir = tmp_dir.name
+            faults = []
+            if args.kill_replica_at is not None:
+                faults.append(faultinject.replica_kill(
+                    at_request=args.kill_replica_at, replica=0,
+                ))
+            router = FleetRouter(
+                replicas=args.replicas,
+                journal_dir=journal_dir,
+                lease_s=args.lease,
+                faults=faultinject.FaultPlan(*faults),
+                lanes=args.lanes,
+                chunk=args.chunk,
+                queue_capacity=args.queue_capacity,
+                max_retries=args.retries,
+                keep_solutions=False,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        rng = random.Random(args.seed)
+        t0 = _time.monotonic()
+        results: dict = {}
+        drained_early = False
+        try:
+            with _SigtermDrain() as term:
+                for _ in range(args.requests):
+                    if term.requested:
+                        drained_early = True
+                        obs_trace.event("serve:sigterm-drain")
+                        results.update(router.shutdown())
+                        break
+                    M, N = rng.choice(grids)
+                    router.submit(
+                        Problem(M=M, N=N), deadline_s=args.deadline,
+                    )
+                    _time.sleep(min(rng.expovariate(args.rate), 0.05))
+                    router.step()
+                    results.update(router.collect())
+                else:
+                    results.update(router.drain())
+                    results.update(router.collect())
+        except FleetUnavailableError as e:
+            print(
+                f"error: fleet unavailable — {e}",
+                file=sys.stderr,
+            )
+            return e.exit_code
+        wall = _time.monotonic() - t0
+        counts: dict[str, int] = {}
+        for res in results.values():
+            counts[res.outcome] = counts.get(res.outcome, 0) + 1
+        completed = counts.get("completed", 0)
+        handoff = obs_metrics.REGISTRY.histogram(
+            obs_metrics.HANDOFF_LATENCY_SECONDS
+        )
+        record = {
+            "replicas": args.replicas,
+            "requests": args.requests,
+            "outcomes": counts,
+            "solves_per_sec": round(completed / wall, 2) if wall else None,
+            "handoffs": router.handoffs,
+            "adopted": router.adopted_total,
+            "handoff_p99_s": handoff.quantile(0.99),
+            "live_replicas": [r.replica_id for r in router.live_replicas()],
+            "wall_s": round(wall, 4),
+            "drained_on_sigterm": drained_early,
+        }
+        obs_trace.event("fleet_report", **record)
+        if args.metrics:
+            from poisson_ellipse_tpu.obs.export import MetricsExporter
+
+            err = MetricsExporter(args.metrics).try_write()
+            if err is not None:
+                print(
+                    f"warning: metrics snapshot failed: {err}",
+                    file=sys.stderr,
+                )
+        if args.json:
+            print(json.dumps(record))
+        else:
+            outcome_str = ", ".join(
+                f"{k}={v}" for k, v in sorted(counts.items())
+            )
+            print(
+                f"fleet: {args.requests} requests over {args.replicas} "
+                f"replicas in {wall:.2f}s — {outcome_str}; "
+                f"{record['solves_per_sec']} solves/sec aggregate; "
+                f"{router.handoffs} handoff(s), {router.adopted_total} "
+                "request(s) adopted"
+            )
+        if drained_early:
+            return 0
+        return max((EXIT_BY_OUTCOME[o] for o in counts), default=0)
+    finally:
+        obs_metrics.REGISTRY.emit()
+        obs_metrics.REGISTRY.reset()
+        if tmp_dir is not None:
+            tmp_dir.cleanup()
+        if args.trace:
+            obs_trace.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "inspect":
@@ -928,6 +1172,8 @@ def main(argv=None) -> int:
         return _run_diagnose(argv[1:])
     if argv and argv[0] == "serve":
         return _run_serve(argv[1:])
+    if argv and argv[0] == "fleet":
+        return _run_fleet(argv[1:])
     if argv and argv[0] == "chaos":
         return _run_chaos(argv[1:])
     ap = argparse.ArgumentParser(

@@ -8,7 +8,7 @@ caught by hand across five rewrites. tpulint catches them mechanically:
     python -m poisson_ellipse_tpu.lint              # paths from pyproject
     python -m poisson_ellipse_tpu.lint poisson_ellipse_tpu/ops --statistics
 
-Rules are TPU001–TPU013 (see :mod:`.rules`); any finding can be waived
+Rules are TPU001–TPU016 (see :mod:`.rules`); any finding can be waived
 in place with a trailing or preceding-line comment::
 
     x = jnp.zeros(n, jnp.float64)  # tpulint: disable=TPU001

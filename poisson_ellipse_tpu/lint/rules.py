@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU015.
+"""The tpulint rule registry: TPU001–TPU016.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -58,6 +58,12 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | and silently downcasts the host-f64 one;      |
 |        |                    | validation runs on host arrays, the traced    |
 |        |                    | path stays pure                               |
+| TPU016 | wall-clock-deadline| `time.time()` feeding a comparison used as a  |
+|        |                    | lease/deadline/timeout — wall clocks step     |
+|        |                    | under NTP, so a wall-clock lease fires early  |
+|        |                    | or never; deadline arithmetic must read       |
+|        |                    | `time.monotonic()` (timestamps that are only  |
+|        |                    | recorded, never compared, stay silent)        |
 """
 
 from __future__ import annotations
@@ -2038,3 +2044,189 @@ def check_host_roundtrip(module: Module, config: LintConfig) -> Iterator[Finding
                         "conversions in the (untraced) caller on host "
                         "arrays",
                     )
+
+
+# --------------------------------------------------------------------------
+# TPU016 — wall-clock time feeding lease/deadline/timeout comparisons
+# --------------------------------------------------------------------------
+
+
+def _wall_clock_calls(module: Module, root: ast.AST) -> list[ast.Call]:
+    """Every ``time.time()`` call in ``root``'s subtree."""
+    return [
+        node
+        for node in ast.walk(root)
+        if isinstance(node, ast.Call)
+        and (module.qualname(node.func) or "") == "time.time"
+    ]
+
+
+def _is_ordering_compare(node: ast.Compare) -> bool:
+    """A deadline check is an ORDERING comparison (<, <=, >, >=): an
+    identity/equality/membership test (``is None`` lazy-init guards,
+    ``rid in finished``) reads a value, not a clock order, and must not
+    turn a record-only timestamp into a finding."""
+    return any(
+        isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+        for op in node.ops
+    )
+
+
+def _name_compared_in(scope_root: ast.AST, name: str,
+                      exclude: set = frozenset()) -> bool:
+    """Is ``name`` read inside any ORDERING comparison within
+    ``scope_root``, excluding the ``exclude`` subtrees (scopes where
+    the spelling is a different local binding — the TPU012 shadowing
+    discipline, reused)?"""
+    for node in _walk_excluding(scope_root, exclude):
+        if not isinstance(node, ast.Compare) or not _is_ordering_compare(node):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _self_attr_compared(scope_root: ast.AST, attr: str) -> bool:
+    """Is ``self.<attr>`` read inside any comparison within
+    ``scope_root`` — the assignment's enclosing CLASS (methods share
+    the instance, so attribute deadlines are class-wide), or the module
+    for a classless ``self`` oddity? Another class's same-named
+    attribute is a different instance's slot and must not be smeared
+    onto a record-only timestamp here."""
+    for node in ast.walk(scope_root):
+        if not isinstance(node, ast.Compare) or not _is_ordering_compare(
+            node
+        ):
+            continue
+        for sub in ast.walk(node):
+            if _attr_is_self(sub, attr):
+                return True
+    return False
+
+
+def _enclosing_class(module: Module, node: ast.AST):
+    """The innermost ClassDef enclosing ``node`` (None outside one)."""
+    innermost = None
+    for anc in module.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            if innermost is None or anc.lineno >= innermost.lineno:
+                innermost = anc
+    return innermost
+
+
+@rule(
+    "TPU016",
+    "wall-clock-deadline",
+    "time.time() feeding a comparison used as a lease/deadline/timeout — "
+    "NTP steps make wall-clock deadlines fire early or never; use "
+    "time.monotonic()",
+)
+def check_wall_clock_deadline(module: Module, config: LintConfig) -> Iterator[Finding]:
+    """The lease-correctness fence the fleet layer is built on
+    (``fleet.replica``): a lease, deadline or timeout computed from
+    ``time.time()`` is one NTP step away from firing years early (a
+    backward step fences a healthy replica and hands its work off
+    twice) or never (a forward step keeps a dead one's lease alive
+    forever). ``time.monotonic()`` is immune by construction, which is
+    why every clock in ``serve``/``fleet`` is injectable monotonic.
+
+    Two conservative prongs — a wall-clock read that is merely
+    *recorded* (a ``t_admit_unix`` journal field, a trace record's
+    ``unix_time``) is a timestamp, not a deadline, and stays silent:
+
+    - **compared directly** — a ``time.time()`` call anywhere inside a
+      comparison (``if time.time() > deadline``, ``time.time() - t0 >
+      timeout``): the comparison IS the deadline check.
+    - **bound then compared** — a name (or ``self`` attribute) assigned
+      an expression containing ``time.time()`` (``deadline =
+      time.time() + lease_s``) that is later read inside some
+      comparison in the same module: the binding feeds a deadline even
+      though the compare sits elsewhere.
+    """
+    emitted: set[tuple[int, int]] = set()
+
+    def once(finding):
+        key = (finding.line, finding.col)
+        if key not in emitted:
+            emitted.add(key)
+            yield finding
+
+    # prong 1: time.time() inside an ORDERING comparison (equality/
+    # membership/identity tests read values, not clock order)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Compare) or not _is_ordering_compare(
+            node
+        ):
+            continue
+        for call in _wall_clock_calls(module, node):
+            yield from once(_finding(
+                module,
+                call,
+                "TPU016",
+                "`time.time()` inside a comparison: this is a "
+                "wall-clock deadline/timeout check, and an NTP step "
+                "makes it fire early or never — use `time.monotonic()` "
+                "for every lease/deadline/timeout comparison "
+                "(timestamps that are only recorded may stay on the "
+                "wall clock)",
+            ))
+
+    # prong 2: NAME/self.ATTR = <expr containing time.time()>, with the
+    # binding later read inside a comparison the binding is VISIBLE to —
+    # a function-local `t0` compared in some other function's scope is a
+    # different binding and must not be smeared onto this one (the
+    # TPU012 shadowing discipline, reused)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        value = node.value
+        if value is None:
+            continue
+        calls = _wall_clock_calls(module, value)
+        if not calls:
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        # direct Name / self.ATTR targets only (tuple-unpacked included):
+        # a subscript target (`records[rid] = {..., time.time()}`) binds
+        # a container ITEM no comparison can read by name — walking its
+        # index expression would smear unrelated compared names (a
+        # `rid in finished` membership test) onto a record-only
+        # timestamp, which is exactly the false positive that gets a
+        # lint gate deleted from CI
+        flat: list[ast.AST] = []
+        for target in targets:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                flat.extend(target.elts)
+            else:
+                flat.append(target)
+        enclosing = module.enclosing_function(node)
+        hot = False
+        for t in flat:
+            if isinstance(t, ast.Name):
+                scope = enclosing if enclosing is not None else module.tree
+                if _name_compared_in(
+                    scope, t.id, _shadowing_functions(scope, t.id)
+                ):
+                    hot = True
+            elif _attr_is_self(t, getattr(t, "attr", "")):
+                cls = _enclosing_class(module, node)
+                if _self_attr_compared(
+                    cls if cls is not None else module.tree, t.attr
+                ):
+                    hot = True
+        if not hot:
+            continue
+        for call in calls:
+            yield from once(_finding(
+                module,
+                call,
+                "TPU016",
+                "`time.time()` feeds a binding later used in a "
+                "comparison: a wall-clock lease/deadline — an NTP step "
+                "makes it fire early or never. Compute deadlines from "
+                "`time.monotonic()`; keep wall-clock reads for "
+                "record-only timestamps",
+            ))

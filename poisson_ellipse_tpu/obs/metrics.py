@@ -228,3 +228,30 @@ def gauge(name: str) -> Gauge:
 
 def histogram(name: str) -> Histogram:
     return REGISTRY.histogram(name)
+
+
+# -- the fleet vocabulary ----------------------------------------------------
+#
+# One spelling for the replicated-serving series (fleet.*), so dashboards,
+# tests and the OpenMetrics snapshot agree on names:
+#
+#   fleet_queue_depth_r{i}       gauge      per-replica admission depth
+#   fleet_in_flight_r{i}         gauge      per-replica laned requests
+#   lease_expiry_total           counter    leases the router declared dead
+#   fleet_handoff_total          counter    journal handoffs executed
+#   fleet_handoff_requests_total counter    requests re-admitted by handoff
+#   fleet_stale_writes_total     counter    fenced zombie writes rejected
+#   handoff_latency_seconds      histogram  per-handoff journal→survivor time
+
+LEASE_EXPIRY_TOTAL = "lease_expiry_total"
+FLEET_HANDOFF_TOTAL = "fleet_handoff_total"
+FLEET_HANDOFF_REQUESTS_TOTAL = "fleet_handoff_requests_total"
+FLEET_STALE_WRITES_TOTAL = "fleet_stale_writes_total"
+HANDOFF_LATENCY_SECONDS = "handoff_latency_seconds"
+
+
+def replica_gauge(name: str, replica: int) -> Gauge:
+    """The per-replica gauge ``<name>_r<replica>`` (flat names: the
+    registry is label-free by design, so the replica index rides in the
+    metric name exactly like the OpenMetrics snapshot renders it)."""
+    return REGISTRY.gauge(f"{name}_r{replica}")

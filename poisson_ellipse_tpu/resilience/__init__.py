@@ -34,11 +34,13 @@ service survived it":
 from poisson_ellipse_tpu.resilience.errors import (
     EXIT_DEVICE_LOSS,
     EXIT_DIVERGED,
+    EXIT_FLEET_UNAVAILABLE,
     EXIT_OOM,
     EXIT_SDC,
     EXIT_TIMEOUT,
     DeviceLossError,
     DivergedError,
+    FleetUnavailableError,
     OutOfMemoryError,
     SilentCorruptionError,
     SolveError,
@@ -56,7 +58,10 @@ from poisson_ellipse_tpu.resilience.faultinject import (
     halo_bitflip,
     inject_nan,
     inject_stagnation,
+    lease_clock_skew,
     psum_corrupt,
+    replica_hang,
+    replica_kill,
     simulate_oom,
     simulated_vmem,
     straggler,
@@ -84,12 +89,14 @@ __all__ = [
     "ElasticResult",
     "EXIT_DEVICE_LOSS",
     "EXIT_DIVERGED",
+    "EXIT_FLEET_UNAVAILABLE",
     "EXIT_OOM",
     "EXIT_SDC",
     "EXIT_TIMEOUT",
     "DivergedError",
     "Fault",
     "FaultPlan",
+    "FleetUnavailableError",
     "GuardedResult",
     "HEALTH_BREAKDOWN",
     "HEALTH_CONVERGED",
@@ -114,7 +121,10 @@ __all__ = [
     "inject_stagnation",
     "is_device_loss_error",
     "is_oom_error",
+    "lease_clock_skew",
     "psum_corrupt",
+    "replica_hang",
+    "replica_kill",
     "simulate_oom",
     "simulated_vmem",
     "straggler",

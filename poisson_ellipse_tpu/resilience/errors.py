@@ -35,6 +35,12 @@ code the harness CLI contracts to return:
                                     boundary contact, or an assembled
                                     operator that fails the finite/M-matrix/
                                     SPD checks
+  9         FleetUnavailableError   every scheduler replica of the serving
+                                    fleet (``fleet.router``) is dead or
+                                    draining: there is no admission path
+                                    left, so the request is refused loudly
+                                    (with ``retry_after_s``) instead of
+                                    hanging on a queue nobody will drain
   ========  ======================  =========================================
 
 (exit 0 = converged, 1 = iteration cap reached without convergence — the
@@ -61,6 +67,7 @@ EXIT_SHED = 5
 EXIT_SDC = 6
 EXIT_DEVICE_LOSS = 7
 EXIT_INVALID_GEOMETRY = 8
+EXIT_FLEET_UNAVAILABLE = 9
 
 
 class SolveError(RuntimeError):
@@ -184,6 +191,24 @@ class InvalidGeometryError(SolveError):
     def __init__(self, message: str, reason: str = "invalid"):
         super().__init__(message)
         self.reason = reason
+
+
+class FleetUnavailableError(SolveError):
+    """Every scheduler replica of the serving fleet is down (dead lease,
+    fenced, or draining): the router has no admission path left. This is
+    the fleet-wide analog of :class:`AdmissionRejected` — refused loudly
+    NOW with a ``retry_after_s`` hint, never a request parked on a queue
+    no surviving replica will ever drain. Anything short of total loss is
+    *routed around*, not raised: a single dead replica's queued and
+    in-flight requests are handed off to survivors
+    (``fleet.handoff``)."""
+
+    classification = "fleet-unavailable"
+    exit_code = EXIT_FLEET_UNAVAILABLE
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 # status phrasings XLA/Mosaic use for memory exhaustion, across runtime

@@ -49,6 +49,14 @@ guard):
                    dispatch: the slow-device shape. The mesh guard's
                    per-chunk deadline turns it into a detected
                    degradation, exactly like a loss.
+- ``replica_kill`` / ``replica_hang`` / ``lease_clock_skew`` — the
+                   REPLICA-level faults the fleet router consults
+                   (``fleet.router``): SIGKILL a whole scheduler
+                   replica at arrival ``at_request``, hang its
+                   heartbeat while the process lives (the zombie
+                   drill), or skew its lease clock (the NTP-step
+                   drill). All seed-deterministic and addressable from
+                   chaos plans like every other kind.
 
 Separately, :func:`simulated_vmem` shrinks the VMEM capacity the engine
 capacity gates (``fits_resident``/``fits_streamed``) read — so
@@ -79,6 +87,7 @@ FAULT_KINDS = (
     "nan", "breakdown", "stagnation", "halo", "oom",
     "halo_bitflip", "psum_corrupt", "device_loss", "straggler",
     "malformed_spec", "degenerate_geometry",
+    "replica_kill", "replica_hang", "lease_clock_skew",
 )
 
 # dispatch-level faults: consulted by the driver holding the dispatch
@@ -89,6 +98,12 @@ DISPATCH_KINDS = ("oom", "device_loss", "straggler")
 # request reaches the queue — they swap the request's geometry spec, so
 # the admission gate (geom.validate) is what gets exercised, not a carry
 ADMISSION_KINDS = ("malformed_spec", "degenerate_geometry")
+
+# replica-level faults: consulted by the fleet router (fleet.router) at
+# arrival boundaries, never by a scheduler or a carry — they kill, hang
+# or clock-skew a WHOLE scheduler replica, so the lease/fencing/handoff
+# machinery is what gets exercised
+REPLICA_KINDS = ("replica_kill", "replica_hang", "lease_clock_skew")
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -161,6 +176,16 @@ class Fault:
     # degenerate_geometry: the clamp threshold the swapped-in sliver
     # spec carries (None = the quadrature default)
     theta: float | None = None
+    # replica-level addressing (fleet.router): ``replica`` names the
+    # target replica index; ``at_request`` the fleet arrival index the
+    # fault fires at (the fleet's analog of ``at_iter``). ``delay_s``
+    # doubles as the hang duration for ``replica_hang``; ``skew_s`` is
+    # the injected lease-clock offset for ``lease_clock_skew`` (the
+    # NTP-step drill: a skewed replica's renewals land short, so its
+    # lease expires under the router's clock while the process lives)
+    replica: int = 0
+    at_request: int = 0
+    skew_s: float = 0.0
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -182,6 +207,13 @@ class Fault:
             )
         if self.kind == "straggler" and self.delay_s < 0:
             raise ValueError("delay_s must be >= 0")
+        if self.kind in REPLICA_KINDS:
+            if self.replica < 0:
+                raise ValueError("replica must be >= 0")
+            if self.at_request < 0:
+                raise ValueError("at_request must be >= 0")
+            if self.kind == "replica_hang" and self.delay_s < 0:
+                raise ValueError("delay_s must be >= 0")
 
 
 def inject_nan(at_iter: int, field: str = "r",
@@ -262,6 +294,40 @@ def degenerate_geometry(theta: float | None = None,
     (``theta`` at its default) the request must SOLVE cleanly — the
     drill asserts the clamp, not a rejection."""
     return Fault("degenerate_geometry", request_id=request_id, theta=theta)
+
+
+def replica_kill(at_request: int = 0, replica: int = 0) -> Fault:
+    """SIGKILL one scheduler replica of the fleet when arrival
+    ``at_request`` lands: its process object is dropped with requests
+    queued and in flight, its fencing token is revoked, and its journal
+    is handed off to the survivors (``fleet.handoff``). The fleet chaos
+    invariants (zero lost / zero double / all classified) are what the
+    drill asserts."""
+    return Fault("replica_kill", at_request=at_request, replica=replica)
+
+
+def replica_hang(delay_s: float = float("inf"), at_request: int = 0,
+                 replica: int = 0) -> Fault:
+    """The zombie drill: the replica's PROCESS stays alive but stops
+    heartbeating (and stepping) for ``delay_s`` seconds from arrival
+    ``at_request``. Its lease expires under the router's clock, it is
+    declared dead and fenced, its work is handed off — and when the
+    zombie resurrects mid-handoff and tries to complete a request, the
+    fenced journal write MUST be rejected (the zero-double pin)."""
+    return Fault("replica_hang", at_request=at_request, replica=replica,
+                 delay_s=delay_s)
+
+
+def lease_clock_skew(skew_s: float, at_request: int = 0,
+                     replica: int = 0) -> Fault:
+    """The NTP-step drill: from arrival ``at_request`` the replica's
+    lease renewals are computed on a clock ``skew_s`` seconds behind the
+    router's, so every renewed deadline lands short. A skew past the
+    lease length makes a perfectly healthy replica read as expired —
+    the router must fence it (stale writes rejected, work handed off)
+    rather than let two replicas both believe they own the requests."""
+    return Fault("lease_clock_skew", at_request=at_request,
+                 replica=replica, skew_s=skew_s)
 
 
 MALFORMED_SPEC = {"kind": "dodecahedron", "r": -1.0}

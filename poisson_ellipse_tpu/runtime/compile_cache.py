@@ -128,6 +128,17 @@ def lane_bucket(lanes: int) -> int:
     return 1 << (lanes - 1).bit_length()
 
 
+def warm_affinity_key(M: int, N: int, norm: str = "weighted") -> tuple:
+    """The compile-bucket affinity key a request of grid (M, N) lands
+    in: ``(grid_bucket, norm)`` — exactly the key the serve scheduler's
+    batch contexts (``serve.scheduler._ctxs``) and this pool's bucketed
+    executables share. The fleet router (``fleet.router``) routes by it:
+    a request sent to a replica already holding this key's live batch
+    context runs on an executable that is ALREADY warm — zero retrace,
+    zero recompile, no cold-start tax on the unlucky replica."""
+    return (grid_bucket(M, N), norm)
+
+
 # -- the AOT warm pool -------------------------------------------------------
 
 

@@ -52,6 +52,14 @@ class ChaosReport:
     faults_fired: int
     wall_s: float
     mesh_killed: bool = False  # a device-loss drill ran mid-stream
+    # the fleet drill's evidence (replicas > 1): handoffs executed,
+    # requests adopted by survivors, fenced zombie writes observed and
+    # rejected, and whether the zombie-resurrection drill ran
+    replicas: int = 1
+    handoffs: int = 0
+    adopted: int = 0
+    stale_writes_rejected: int = 0
+    zombie_drill: bool = False
 
     @property
     def ok(self) -> bool:
@@ -84,6 +92,11 @@ def run_chaos(
     mesh_kill_request: Optional[int] = None,
     malformed_request: Optional[int] = None,
     degenerate_request: Optional[int] = None,
+    replicas: int = 1,
+    replica_kill: Optional[int] = None,
+    kill_during_handoff: bool = False,
+    zombie: bool = False,
+    lease_s: float = 0.25,
 ) -> ChaosReport:
     """Drive one seeded chaos stream; see the module docstring.
 
@@ -112,11 +125,54 @@ def run_chaos(
     one must pass the gate and SOLVE cleanly under the clamp — and in
     both cases every OTHER request's lane runs clean (zero poisoning,
     asserted by the same global invariants).
+
+    ``replicas > 1`` switches the stream onto a FLEET
+    (``fleet.FleetRouter``): same seeded arrivals, same invariant
+    triple, but the failures are replica-scale. ``replica_kill`` names
+    the arrival index at which replica 0 is SIGKILLed (its journal
+    hands off to the survivors); ``kill_during_handoff`` additionally
+    kills replica 1 at the same boundary — the adopted-but-not-yet-run
+    requests must survive the second kill because adoption is
+    journal-first; ``zombie`` arms the replica-hang drill instead of a
+    kill (lease expires while the process lives, work is handed off,
+    and the resurrected zombie's completion attempt MUST be rejected
+    by its fenced journal — the observed-and-rejected stale write is
+    part of the report). The per-request NaN/OOM faults keep firing on
+    whichever replica hosts their victims — one plan, fleet-wide.
     """
     if n_requests < 1:
         raise ValueError("need at least one request")
     if rate_per_s <= 0:
         raise ValueError("rate_per_s must be > 0")
+    if replicas > 1:
+        # the single-scheduler drills do not arm on the fleet path —
+        # refuse them LOUDLY rather than report invariants a drill
+        # that never ran cannot have tested (replica_kill is the
+        # fleet's kill; mesh/geometry drills are single-scheduler)
+        dropped = {
+            "kill_after": kill_after,
+            "mesh_kill_request": mesh_kill_request,
+            "malformed_request": malformed_request,
+            "degenerate_request": degenerate_request,
+        }
+        armed = [k for k, v in dropped.items() if v is not None]
+        if armed:
+            raise ValueError(
+                f"{', '.join(armed)} are single-scheduler drills the "
+                "fleet path (replicas > 1) does not run — use "
+                "replica_kill/kill_during_handoff/zombie for fleet "
+                "failure modes, or replicas=1 for these"
+            )
+        return _run_fleet_chaos(
+            n_requests=n_requests, seed=seed, grids=grids,
+            rate_per_s=rate_per_s, lanes=lanes, chunk=chunk,
+            queue_capacity=queue_capacity, journal_path=journal_path,
+            nan_request=nan_request, oom_request=oom_request,
+            deadline_s=deadline_s, max_retries=max_retries,
+            replicas=replicas, replica_kill=replica_kill,
+            kill_during_handoff=kill_during_handoff, zombie=zombie,
+            lease_s=lease_s,
+        )
     if kill_after is None:
         kill_after = n_requests // 2
     kill = kill_after is not None and 0 < kill_after < n_requests
@@ -232,4 +288,201 @@ def run_chaos(
         ),
     )
     obs_trace.event("serve:chaos-report", **report.json_dict())
+    return report
+
+
+def _run_fleet_chaos(
+    n_requests: int,
+    seed: int,
+    grids,
+    rate_per_s: float,
+    lanes: int,
+    chunk: int,
+    queue_capacity: int,
+    journal_path,
+    nan_request: Optional[int],
+    oom_request: Optional[int],
+    deadline_s: Optional[float],
+    max_retries: int,
+    replicas: int,
+    replica_kill: Optional[int],
+    kill_during_handoff: bool,
+    zombie: bool,
+    lease_s: float,
+) -> ChaosReport:
+    """The fleet half of :func:`run_chaos` (see its docstring).
+
+    ``journal_path`` names the fleet's journal DIRECTORY (one ledger per
+    replica) and is mandatory — the handoff under test IS the journals.
+    The kill/hang indices are seed-independent constants of the call,
+    so the whole drill is deterministic per (seed, parameters): same
+    arrivals, same victim, same handoff boundary, same outcomes.
+    """
+    from poisson_ellipse_tpu.fleet import FleetRouter, StaleLeaseError
+    from poisson_ellipse_tpu.resilience import faultinject
+    from poisson_ellipse_tpu.resilience.errors import FleetUnavailableError
+    from poisson_ellipse_tpu.serve.request import ServeResult
+
+    if journal_path is None:
+        raise ValueError(
+            "fleet chaos needs journal_path (a directory: the "
+            "journal-backed handoff is the invariant under test)"
+        )
+    if kill_during_handoff and replicas < 3:
+        raise ValueError(
+            "kill_during_handoff kills TWO replicas at one boundary; "
+            "the drill needs replicas >= 3 so an adopter survives "
+            "(with 2 the stream would just hit the exit-9 total-loss "
+            "path, which is its own drill)"
+        )
+    if kill_during_handoff and zombie and replica_kill is None:
+        raise ValueError(
+            "kill_during_handoff rides the replica_kill drill's "
+            "handoff boundary; combining it with zombie needs an "
+            "explicit replica_kill index (zombie alone arms no kill)"
+        )
+    if replica_kill is None and not zombie:
+        replica_kill = n_requests // 2
+    rng = random.Random(seed)
+    faults = []
+    if nan_request is not None and nan_request < n_requests:
+        faults.append(Fault(
+            "nan", at_iter=4, field="r", request_id=_chaos_id(nan_request),
+        ))
+    if oom_request is not None and oom_request < n_requests:
+        faults.append(Fault(
+            "oom", at_iter=2, request_id=_chaos_id(oom_request),
+        ))
+    if replica_kill is not None and 0 < replica_kill < n_requests:
+        faults.append(faultinject.replica_kill(
+            at_request=replica_kill, replica=0,
+        ))
+    hang_at = None
+    if zombie:
+        hang_at = max(n_requests // 3, 1)
+        faults.append(faultinject.replica_hang(
+            delay_s=float("inf"), at_request=hang_at, replica=0,
+        ))
+    plan = FaultPlan(*faults)
+
+    t0 = time.monotonic()
+    router = FleetRouter(
+        replicas=replicas,
+        journal_dir=journal_path,
+        lease_s=lease_s,
+        faults=plan,
+        lanes=lanes,
+        chunk=chunk,
+        queue_capacity=queue_capacity,
+        max_retries=max_retries,
+        backoff_base_s=0.001,
+        keep_solutions=False,
+        # the per-replica schedulers share the ONE plan, so the
+        # request-addressed faults fire on whichever replica hosts
+        # their victim — and fire once, fleet-wide
+    )
+    results: dict[str, object] = {}
+
+    def harvest():
+        # double detection lives in the ROUTER's delivery ledger
+        # (FleetRouter.harvest: each terminal record passes exactly
+        # once, so a second delivery per id IS the bug), not in an
+        # object-identity heuristic that a dict merge could launder
+        results.update(router.harvest())
+
+    stale_rejected = 0
+    second_killed = False
+    for i in range(n_requests):
+        time.sleep(min(rng.expovariate(rate_per_s), 0.01))
+        M, N = rng.choice(list(grids))
+        req_kw = dict(
+            deadline_s=deadline_s, max_retries=max_retries,
+            request_id=_chaos_id(i),
+        )
+        try:
+            router.submit(Problem(M=M, N=N), **req_kw)
+        except FleetUnavailableError as e:
+            # total loss mid-stream must stay CLASSIFIED inside the
+            # report (the invariant is "all classified", and a crashed
+            # harness asserts nothing): the refused request records as
+            # a shed — it was never admitted anywhere, loudly
+            results[_chaos_id(i)] = ServeResult(
+                request_id=_chaos_id(i), outcome="shed",
+                detail="fleet-unavailable",
+                retry_after_s=e.retry_after_s,
+            )
+        if kill_during_handoff and replica_kill is not None and \
+                i >= replica_kill and not second_killed:
+            # the second kill lands at the SAME boundary the first
+            # handoff finished on: the adopted-but-not-yet-run requests
+            # are owned only by replica 1's journal — journal-first
+            # adoption is what keeps them alive through this
+            second_killed = True
+            router.kill_replica(1)
+        if zombie and hang_at is not None and i == hang_at:
+            # fast-forward the HUNG replica's lease into the past (the
+            # deterministic stand-in for "its renewals stopped a lease
+            # ago") — sleeping the wall clock instead would also lapse
+            # the healthy replicas' leases and turn the drill racy; the
+            # honest wall-clock expiry path is pinned with a FakeClock
+            # in tests/test_fleet.py
+            hung = router._by_id(0)
+            if hung is not None and hung.live:
+                hung.lease.deadline = router.clock() - 1.0
+        router.step()
+        harvest()
+    # zombie resurrection: the hang clears, the dead-but-alive replica
+    # runs its own serve loop again — every completion it attempts must
+    # be rejected by its fenced journal, never delivered
+    zombie_rep = router.zombies.get(0)
+    if zombie and zombie_rep is not None:
+        zombie_rep.hung_until = 0.0
+        for _ in range(500):
+            try:
+                if not zombie_rep.resurrect_step():
+                    break
+            except StaleLeaseError:
+                stale_rejected += 1
+                break
+    try:
+        router.drain()
+    except FleetUnavailableError:
+        # every replica died with admitted work stranded: the report —
+        # not an exception — is the verdict, and the stranded ids show
+        # up in `lost`, which is exactly what that scenario IS
+        pass
+    harvest()
+
+    submitted = [_chaos_id(i) for i in range(n_requests)]
+    outcomes = {
+        rid: results[rid].outcome for rid in submitted if rid in results
+    }
+    lost = [rid for rid in submitted if rid not in outcomes]
+    unclassified = [
+        rid for rid, out in outcomes.items() if out not in OUTCOMES
+    ]
+    double = sorted(set(router.double_delivered))
+    counts: dict[str, int] = {}
+    for out in outcomes.values():
+        counts[out] = counts.get(out, 0) + 1
+    report = ChaosReport(
+        n_requests=n_requests,
+        outcomes=outcomes,
+        counts=counts,
+        lost=lost,
+        double_completed=double,
+        unclassified=unclassified,
+        replayed=router.adopted_total,
+        killed=any(
+            f.kind == "replica_kill" and f.fired for f in faults
+        ) or second_killed,
+        faults_fired=sum(1 for f in faults if f.fired),
+        wall_s=time.monotonic() - t0,
+        replicas=replicas,
+        handoffs=router.handoffs,
+        adopted=router.adopted_total,
+        stale_writes_rejected=stale_rejected,
+        zombie_drill=zombie,
+    )
+    obs_trace.event("serve:fleet-chaos-report", **report.json_dict())
     return report

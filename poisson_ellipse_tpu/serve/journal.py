@@ -16,6 +16,15 @@ request already in a terminal state raises instead of overwriting —
 the chaos harness's zero-double-completion invariant is enforced where
 the record lives, not just asserted after the fact.
 
+Fleet fencing rides the same choke point: a journal owned by a fleet
+replica carries a **fencing token** (``fence`` — issued by the fleet's
+lease authority, ``fleet.replica``), every mutation calls
+``fence.check()`` BEFORE touching the record, and every flushed
+snapshot embeds ``fence.value``. A replica whose lease expired has its
+token revoked, so a zombie resurrecting mid-handoff cannot admit or
+complete anything — the stale write raises (and is trace-evented by the
+token) at the exact layer the zero-lost/zero-double promises live.
+
 Finished records are compacted: a terminal outcome *removes* the
 request's record from the snapshot (its id is retained in a small
 in-process set so double completion still raises) and bumps a durable
@@ -52,13 +61,26 @@ class RequestJournal:
     (first boot). A leftover ``<path>.tmp`` from a mid-write kill is
     ignored and overwritten — the rename never happened, so the main
     snapshot is still the truth.
+
+    ``fence`` is an optional fencing token (``fleet.replica``'s
+    ``FencingToken``, or any object with ``check()`` and ``value``):
+    when set, every mutation is fenced — ``check()`` runs before the
+    record is touched and raises on a revoked token — and every
+    snapshot embeds ``value`` so the on-disk ledger names the epoch
+    that wrote it.
     """
 
-    def __init__(self, path):
+    def __init__(self, path, fence=None):
         self.path = os.fspath(path)
+        self.fence = fence
         self._records: dict[str, dict] = {}
         self._finished_ids: set[str] = set()
         self._finished_total = 0
+        # the fencing token embedded in the loaded snapshot (None for a
+        # fresh journal or one written unfenced) — forensic evidence of
+        # WHICH epoch last wrote the ledger, surfaced for the fleet's
+        # stale-write tests and post-incident reads
+        self.loaded_fence_token = None
         if os.path.exists(self.path):
             with open(self.path, encoding="utf-8") as fh:
                 data = json.load(fh)
@@ -69,6 +91,7 @@ class RequestJournal:
                 )
             self._records = data["requests"]
             self._finished_total = data.get("finished", 0)
+            self.loaded_fence_token = data.get("fence_token")
             # a snapshot predating compaction may still carry done
             # records — fold them into the counter and drop them
             done = [
@@ -86,6 +109,7 @@ class RequestJournal:
         request only after this returns (the write-ahead contract).
         Replayed requests re-admit under their original id — idempotent,
         their spec is simply refreshed."""
+        self._check_fence()
         if request.request_id in self._finished_ids:
             raise DoubleCompletionError(
                 f"request {request.request_id} is already finished; "
@@ -104,6 +128,7 @@ class RequestJournal:
         The terminal record is compacted away (see the module
         docstring); only the durable ``finished`` counter and the
         in-process id set remember it."""
+        self._check_fence()
         if outcome not in OUTCOMES:
             raise ValueError(f"outcome {outcome!r} not one of {OUTCOMES}")
         if request_id in self._finished_ids:
@@ -149,6 +174,14 @@ class RequestJournal:
 
     # -- durability ---------------------------------------------------------
 
+    def _check_fence(self) -> None:
+        """The fencing gate every mutation passes first: a revoked token
+        raises (``fleet.replica.StaleLeaseError``) BEFORE the record is
+        touched, so a fenced zombie's admit/outcome never lands — in
+        memory or on disk."""
+        if self.fence is not None:
+            self.fence.check()
+
     def _flush(self) -> None:
         """Write-temp-fsync-rename, the ``solver.checkpoint`` idiom: a
         kill mid-write leaves the previous snapshot, never a torn one."""
@@ -157,6 +190,10 @@ class RequestJournal:
             "requests": self._records,
             "finished": self._finished_total,
         }
+        if self.fence is not None:
+            # every journal write carries the fencing token: the on-disk
+            # snapshot names the epoch that produced it
+            payload["fence_token"] = self.fence.value
         directory = os.path.dirname(os.path.abspath(self.path)) or "."
         fd, tmp = tempfile.mkstemp(prefix=".journal-", dir=directory)
         try:

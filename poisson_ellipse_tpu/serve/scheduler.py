@@ -272,6 +272,11 @@ class Scheduler:
         # never terminally shed (the write-ahead promise outlives one
         # queue's worth of backlog)
         self._replay_backlog: list[ServeRequest] = []
+        # graceful-drain latch (begin_drain): a draining scheduler
+        # refuses NEW admissions with a redirectable shed but finishes
+        # everything already admitted — the fleet's replica-drain hook
+        # and the harness's SIGTERM path both flip it
+        self.draining = False
 
     # -- admission -----------------------------------------------------------
 
@@ -351,7 +356,47 @@ class Scheduler:
             return result
         return None
 
+    def begin_drain(self) -> None:
+        """The graceful-shutdown hook: stop admitting, keep working.
+
+        New submissions are refused with a shed carrying the projected
+        wait as ``retry_after_s`` (and detail ``draining``) WITHOUT
+        being recorded as this scheduler's terminal outcome — the
+        rejection is a redirect for the caller (the fleet router's next
+        replica, a SIGTERM'd CLI's client), not a lifecycle event of a
+        request this scheduler never owned. Everything already admitted
+        (queued, backlogged, in flight) still runs to a classified
+        terminal state through the normal ``drain()``."""
+        if not self.draining:
+            self.draining = True
+            obs_trace.event(
+                "serve:drain-begin",
+                queued=len(self.queue),
+                in_flight=sum(
+                    1 for c in self._ctxs.values()
+                    for s in c.slots if s is not None
+                ),
+            )
+
+    def adopt_request(self, req: ServeRequest) -> None:
+        """Adopt a handed-off request from a dead peer's journal
+        (``fleet.handoff``): journal-first (the write-ahead promise
+        transfers to THIS scheduler before anything acknowledges the
+        handoff), then the replay backlog's wave machinery — an adopted
+        request is never terminally shed on capacity, exactly like a
+        replayed one."""
+        if self.journal is not None:
+            self.journal.record_admit(req)
+        self._replay_backlog.append(req)
+        self._admit_replay_wave()
+
     def submit_request(self, req: ServeRequest) -> Optional[ServeResult]:
+        if self.draining:
+            return ServeResult(
+                request_id=req.request_id, outcome="shed",
+                detail="draining",
+                retry_after_s=self.queue.projected_wait(),
+            )
         prior = self.results.get(req.request_id)
         if prior is not None and prior.outcome == "shed" and not prior.dispatched:
             # shed-at-admission is "safe to resubmit after retry_after_s"
@@ -402,6 +447,24 @@ class Scheduler:
         collected-and-evicted result keeps its journal trail)."""
         return (
             request_id in self.results
+            or self.owns_request(request_id)
+        )
+
+    def owns_request(self, request_id: str) -> bool:
+        """Whether this scheduler owns the id's LIFECYCLE: queued,
+        backlogged, in flight, journaled, or terminal — except a
+        recorded shed that was never dispatched, which is a rejection
+        the outcome table promises is safe to resubmit, not ownership.
+        The fleet router's duplicate gate reads this (dead replicas
+        included: a since-killed replica's journal still remembers what
+        it finished, which is exactly what blocks a client retry from
+        double-completing an already-delivered request)."""
+        prior = self.results.get(request_id)
+        if (prior is not None and prior.outcome == "shed"
+                and not prior.dispatched):
+            prior = None
+        return (
+            prior is not None
             or self.queue.holds(request_id)
             or any(r.request_id == request_id for r in self._replay_backlog)
             or self._slot_of(request_id) is not None
@@ -745,11 +808,15 @@ class Scheduler:
 
     def _record_terminal(self, result: ServeResult,
                          lane: int | None = None) -> None:
-        self.results[result.request_id] = result
+        # journal FIRST: the terminal record lives where the durability
+        # promise does, and a fenced journal (fleet.replica) rejecting a
+        # zombie's stale write must abort the completion BEFORE it lands
+        # in the result buffer a harvester could read
         if self.journal is not None:
             self.journal.record_outcome(
                 result.request_id, result.outcome, detail=result.detail
             )
+        self.results[result.request_id] = result
         if result.outcome == "deadline-miss":
             obs_metrics.counter("deadline_miss_total").inc()
         elif result.outcome == "completed":

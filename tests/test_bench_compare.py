@@ -162,6 +162,67 @@ def test_abft_only_in_one_round_is_noted_not_failed():
     assert regs == []
 
 
+def _fleet_key(**overrides):
+    key = {
+        "rows": [
+            {"replicas": 1, "lanes": 2, "solves_per_sec": 100.0,
+             "completed": 24, "wall_s": 0.24},
+            {"replicas": 2, "lanes": 2, "solves_per_sec": 110.0,
+             "completed": 24, "wall_s": 0.22},
+            {"replicas": 3, "lanes": 2, "solves_per_sec": 115.0,
+             "completed": 24, "wall_s": 0.21},
+        ],
+        "non_decreasing": True,
+        "handoff_p99_s": 0.002,
+        "kill_completed": 24,
+        "handoffs": 1,
+        "adopted": 3,
+    }
+    key.update(overrides)
+    return key
+
+
+def test_fleet_aggregate_drop_is_a_regression():
+    old = make_round(fleet=_fleet_key())
+    new_key = _fleet_key()
+    new_key["rows"][1]["solves_per_sec"] = (
+        110.0 * (1 - TOL["fleet-agg-pct"]) / 2
+    )
+    new = make_round(fleet=new_key)
+    assert regressions_between(old, new) == [
+        ("fleet_solves_per_sec", "fleet replicas=2")
+    ]
+
+
+def test_fleet_broken_scaling_pin_is_a_regression():
+    old = make_round(fleet=_fleet_key())
+    new = make_round(fleet=_fleet_key(non_decreasing=False))
+    assert ("fleet_non_decreasing", "fleet") in regressions_between(old, new)
+
+
+def test_fleet_within_tolerance_is_clean():
+    old = make_round(fleet=_fleet_key())
+    new_key = _fleet_key()
+    new_key["rows"][0]["solves_per_sec"] = (
+        100.0 * (1 - TOL["fleet-agg-pct"] / 2)
+    )
+    assert regressions_between(old, new_round := make_round(fleet=new_key)) == []
+    assert new_round["fleet"]["non_decreasing"]
+
+
+def test_fleet_only_in_one_round_is_noted_not_failed():
+    old = make_round()  # pre-fleet artifact
+    new = make_round(fleet=_fleet_key())
+    regs, notes = bc.compare(old, new, TOL)
+    assert regs == []
+    assert any("fleet" in n for n in notes)
+    # a failed fleet key with no rows skips the same way
+    regs, _ = bc.compare(
+        make_round(fleet={"rows": []}), new, TOL
+    )
+    assert regs == []
+
+
 def _precond_rows():
     return [
         {"grid": [100, 200], "engine": "mg-pcg", "iters": 30,

@@ -1,0 +1,707 @@
+"""The replicated serving fleet (`fleet/`) — ISSUE 12.
+
+The contracts this file pins:
+
+- lease semantics under a hand-cranked monotonic clock: renewal at
+  chunk boundaries, expiry declared by the ROUTER's clock, the zombie
+  (hung process, lapsed lease) fenced before its journal is replayed;
+- fencing at the journal choke point: a stale token's write raises
+  ``StaleLeaseError`` BEFORE the record is touched, is trace-evented
+  (``fleet:stale-write-rejected``) and counted, and every flushed
+  snapshot embeds the writing token;
+- handoff preserves the remaining-deadline budget (the journal's
+  ``deadline_left_s`` contract, unchanged across the replica boundary)
+  and never terminally sheds on capacity (backlog waves);
+- a handed-off request's solution is bit-identical to the same request
+  served by an uninterrupted scheduler — the kill/handoff machinery
+  must not perturb one bit of the answer;
+- routing: warm compile-bucket affinity that still load-spreads,
+  per-replica backpressure aggregated with the minimum retry hint,
+  hedging around suspect leases, fleet-level duplicate-id refusal;
+- all-replicas-down is the classified ``FleetUnavailableError``
+  (exit 9) — loud, carrying ``retry_after_s``, never a hang;
+- graceful drain: ``begin_drain`` refuses new work with a redirectable
+  shed and finishes everything admitted; SIGTERM on ``harness serve``
+  drains (exit 0, trace tail flushed) instead of dying mid-stream;
+- the chaos invariant triple (zero lost / zero double / all
+  classified) holds across replica kill, kill-during-handoff, and
+  zombie resurrection (stale write observed and rejected),
+  deterministically per seed.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from poisson_ellipse_tpu.fleet import (
+    FenceAuthority,
+    FleetRouter,
+    StaleLeaseError,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import metrics as obs_metrics
+from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.resilience.errors import FleetUnavailableError
+from poisson_ellipse_tpu.resilience.faultinject import (
+    FaultPlan,
+    lease_clock_skew,
+    replica_hang,
+)
+from poisson_ellipse_tpu.serve import RequestJournal, ServeRequest, run_chaos
+from poisson_ellipse_tpu.serve.scheduler import Scheduler
+
+
+class FakeClock:
+    """Hand-cranked monotonic clock (the test_serve idiom): lease and
+    deadline semantics become deterministic instead of racing the
+    host."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_router(tmp_path, replicas=2, clock=None, **kw):
+    kw.setdefault("lanes", 2)
+    kw.setdefault("chunk", 8)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("keep_solutions", False)
+    router_kw = {}
+    if clock is not None:
+        router_kw["clock"] = clock
+        router_kw["idle"] = clock.advance
+    return FleetRouter(
+        replicas=replicas, journal_dir=str(tmp_path / "journals"),
+        **router_kw, **kw,
+    )
+
+
+# -- fencing: the zero-double choke point ------------------------------------
+
+
+def test_stale_token_write_rejected_and_trace_evented(tmp_path):
+    authority = FenceAuthority()
+    token = authority.issue(0)
+    journal = RequestJournal(tmp_path / "j.json", fence=token)
+    req = ServeRequest(problem=Problem(M=10, N=10), request_id="r0")
+    journal.record_admit(req)  # valid token: lands
+    path = tmp_path / "fence.jsonl"
+    obs_trace.start(str(path))
+    stale_before = obs_metrics.REGISTRY.counter(
+        obs_metrics.FLEET_STALE_WRITES_TOTAL
+    ).value
+    try:
+        authority.fence(0)
+        with pytest.raises(StaleLeaseError):
+            journal.record_outcome("r0", "completed")
+        with pytest.raises(StaleLeaseError):
+            journal.record_admit(
+                ServeRequest(problem=Problem(M=10, N=10), request_id="r1")
+            )
+    finally:
+        obs_trace.stop()
+    # the rejected write never touched the record: r0 is still live
+    # (admitted, unfinished) and r1 was never admitted
+    reloaded = RequestJournal(tmp_path / "j.json")
+    assert [r.request_id for r in reloaded.unfinished(0.0)] == ["r0"]
+    # trace-evented + counted — the drill is observable, not silent
+    names = [r["name"] for r in obs_trace.read_jsonl(str(path))]
+    assert names.count("fleet:stale-write-rejected") == 2
+    assert obs_trace.validate_file(str(path)) == []
+    assert obs_metrics.REGISTRY.counter(
+        obs_metrics.FLEET_STALE_WRITES_TOTAL
+    ).value == stale_before + 2
+
+
+def test_journal_snapshot_embeds_the_fencing_token(tmp_path):
+    import json
+
+    authority = FenceAuthority()
+    token = authority.issue(3)
+    journal = RequestJournal(tmp_path / "j.json", fence=token)
+    journal.record_admit(
+        ServeRequest(problem=Problem(M=10, N=10), request_id="r0")
+    )
+    with open(tmp_path / "j.json", encoding="utf-8") as fh:
+        snap = json.load(fh)
+    assert snap["fence_token"] == token.value == "r3:e1"
+    # and the loaded journal surfaces the writing epoch
+    assert RequestJournal(tmp_path / "j.json").loaded_fence_token == "r3:e1"
+
+
+def test_reissue_stales_the_previous_incarnation(tmp_path):
+    # a restarted replica under the same id mints a NEW epoch; the dead
+    # incarnation's token is stale from its first write
+    authority = FenceAuthority()
+    old = authority.issue(0)
+    new = authority.issue(0)
+    assert old.stale and not new.stale
+    journal = RequestJournal(tmp_path / "j.json", fence=old)
+    with pytest.raises(StaleLeaseError):
+        journal.record_admit(
+            ServeRequest(problem=Problem(M=10, N=10), request_id="r0")
+        )
+
+
+# -- leases ------------------------------------------------------------------
+
+
+def test_lease_expiry_declares_dead_fences_and_hands_off(tmp_path):
+    clock = FakeClock()
+    router = make_router(
+        tmp_path, replicas=2, clock=clock, lease_s=1.0, lanes=1, chunk=4,
+    )
+    hang = replica_hang(delay_s=float("inf"), at_request=0, replica=0)
+    router.faults.faults.append(hang)
+    for i in range(3):
+        assert router.submit(Problem(M=10, N=10),
+                             request_id=f"r{i}") is None
+    # the hang fault fired at the first arrival: replica 0 stops
+    # heartbeating while its process object lives
+    rep0 = router.replicas[0]
+    assert rep0.hung(clock())
+    expired_before = obs_metrics.REGISTRY.counter(
+        obs_metrics.LEASE_EXPIRY_TOTAL
+    ).value
+    # advance in sub-lease increments (heartbeats are continuous in the
+    # world this simulates): the healthy replica renews at every step's
+    # sweep, the hung one never does — only IT crosses its deadline
+    for _ in range(3):
+        clock.advance(0.6)
+        router.step()
+    assert not rep0.live and rep0.token.stale
+    assert router.replicas[1].live
+    assert router.handoffs == 1
+    assert obs_metrics.REGISTRY.counter(
+        obs_metrics.LEASE_EXPIRY_TOTAL
+    ).value == expired_before + 1
+    # the survivor finishes everything the dead replica owned
+    results = router.drain()
+    assert {results[f"r{i}"].outcome for i in range(3)} == {"completed"}
+    # zombie resurrection: the hung replica's own loop comes back and
+    # every completion it attempts is rejected at its fenced journal
+    rep0.hung_until = 0.0
+    with pytest.raises(StaleLeaseError):
+        for _ in range(200):
+            if not rep0.resurrect_step():
+                break
+    # nothing the zombie did after the fence is visible anywhere
+    assert not rep0.scheduler.results
+
+
+def test_drain_waits_out_a_hung_replicas_lease(tmp_path):
+    # drain with work stuck behind a hung replica must IDLE toward the
+    # lease expiry (then fence + hand off), not hot-spin into the
+    # max_steps backstop before the expiry can land
+    clock = FakeClock()
+    router = make_router(
+        tmp_path, replicas=2, clock=clock, lease_s=1.0, lanes=1, chunk=4,
+        faults=FaultPlan(
+            replica_hang(delay_s=float("inf"), at_request=0, replica=0)
+        ),
+    )
+    for i in range(2):
+        assert router.submit(Problem(M=10, N=10),
+                             request_id=f"h{i}") is None
+    results = router.drain()
+    assert {results[f"h{i}"].outcome for i in range(2)} == {"completed"}
+    assert not router.replicas[0].live and router.handoffs == 1
+
+
+def test_lease_clock_skew_fences_the_skewed_replica(tmp_path):
+    # the NTP-step drill: a skewed replica's renewals land short, so it
+    # reads as expired under the router clock while perfectly healthy —
+    # it must be fenced and its work handed off, not co-owned
+    clock = FakeClock()
+    router = make_router(
+        tmp_path, replicas=2, clock=clock, lease_s=1.0, lanes=1, chunk=4,
+        faults=FaultPlan(
+            lease_clock_skew(skew_s=5.0, at_request=0, replica=0)
+        ),
+    )
+    for i in range(2):
+        assert router.submit(Problem(M=10, N=10),
+                             request_id=f"s{i}") is None
+    router.step()  # skewed renewal: deadline lands 4s in the past
+    clock.advance(0.01)
+    router.step()
+    rep0 = router.replicas[0]
+    assert not rep0.live and rep0.token.stale
+    results = router.drain()
+    assert {results[f"s{i}"].outcome for i in range(2)} == {"completed"}
+
+
+# -- handoff -----------------------------------------------------------------
+
+
+def test_handoff_preserves_remaining_deadline_budget(tmp_path):
+    clock = FakeClock(100.0)
+    router = make_router(
+        tmp_path, replicas=2, clock=clock, lanes=1, chunk=4,
+    )
+    assert router.submit(
+        Problem(M=10, N=10), deadline_s=60.0, request_id="budget"
+    ) is None
+    clock.advance(5.0)
+    # find the owner and kill it: the handoff replays the journaled
+    # remaining-at-admission budget from the handoff clock (the PR 7
+    # replay contract, unchanged across the replica boundary)
+    owner = next(
+        rep for rep in router.replicas
+        if rep.scheduler._knows("budget")
+    )
+    router.kill_replica(owner.replica_id)
+    survivor = next(rep for rep in router.replicas if rep.live)
+    assert survivor.scheduler._knows("budget")
+    req = survivor.scheduler.queue.pop_ready(clock())
+    assert req is not None and req.request_id == "budget"
+    assert req.deadline == pytest.approx(clock() + 60.0, abs=1.0)
+
+
+def test_handed_off_solution_bit_identical_to_uninterrupted(tmp_path):
+    # the kill/handoff machinery must not perturb one bit of the
+    # answer: the same request through (a) a fleet whose owner dies
+    # mid-solve and (b) a plain uninterrupted scheduler must agree
+    # exactly (both re-run from a clean carry on the same embedding)
+    router = make_router(
+        tmp_path, replicas=2, lanes=1, chunk=2, keep_solutions=True,
+    )
+    assert router.submit(Problem(M=12, N=12), request_id="bits") is None
+    router.step()  # a couple of chunks in flight on the owner
+    owner = next(
+        rep for rep in router.replicas if rep.scheduler._knows("bits")
+    )
+    router.kill_replica(owner.replica_id)
+    res = router.drain()["bits"]
+    assert res.outcome == "completed"
+
+    plain = Scheduler(lanes=1, chunk=2, keep_solutions=True)
+    plain.submit(Problem(M=12, N=12), request_id="bits")
+    ref = plain.drain()["bits"]
+    assert ref.outcome == "completed"
+    assert res.iters == ref.iters
+    assert np.array_equal(res.w, ref.w), (
+        "handed-off solution departs bitwise from the uninterrupted one"
+    )
+
+
+def test_kill_with_requests_in_flight_adopts_them(tmp_path):
+    router = make_router(tmp_path, replicas=3, lanes=2, chunk=2)
+    for i in range(6):
+        assert router.submit(Problem(M=10, N=10),
+                             request_id=f"k{i}") is None
+    router.step()
+    router.kill_replica(0)
+    assert router.handoffs == 1 and router.adopted_total >= 1
+    results = router.drain()
+    assert {results[f"k{i}"].outcome for i in range(6)} == {"completed"}
+    # handoff latency was measured
+    hist = obs_metrics.REGISTRY.histogram(
+        obs_metrics.HANDOFF_LATENCY_SECONDS
+    )
+    assert hist.count >= 1
+
+
+# -- routing -----------------------------------------------------------------
+
+
+def test_affinity_prefers_warm_replica_until_lanes_fill(tmp_path):
+    from poisson_ellipse_tpu.runtime.compile_cache import warm_affinity_key
+
+    router = make_router(tmp_path, replicas=2, lanes=2, chunk=4)
+    key = warm_affinity_key(10, 10, "weighted")
+    assert router.submit(Problem(M=10, N=10), request_id="a0") is None
+    router.step()  # replica 0 builds the bucket: it is now warm
+    warm = [rep for rep in router.replicas if key in rep.warm_keys()]
+    assert [r.replica_id for r in warm] == [0]
+    # with a free lane left, the warm replica keeps winning...
+    assert router.submit(Problem(M=10, N=10), request_id="a1") is None
+    assert router.replicas[0].scheduler._knows("a1")
+    # ...but once its lanes fill, the cold replica with free lanes wins
+    # (affinity must not defeat scaling)
+    assert router.submit(Problem(M=10, N=10), request_id="a2") is None
+    assert router.replicas[1].scheduler._knows("a2")
+
+
+def test_all_replicas_shed_returns_min_retry_hint(tmp_path):
+    router = make_router(tmp_path, replicas=2, lanes=1,
+                         queue_capacity=1)
+    for i in range(2):  # one queued request fills each replica's slot
+        assert router.submit(Problem(M=10, N=10),
+                             request_id=f"fill{i}") is None
+    shed = router.submit(Problem(M=10, N=10), request_id="over")
+    assert shed is not None and shed.outcome == "shed"
+    assert shed.detail == "fleet-backpressure"
+    assert shed.retry_after_s is not None and shed.retry_after_s > 0
+    results = router.drain()
+    assert results["over"].outcome == "shed"
+    done = [r for r in results.values() if r.outcome == "completed"]
+    assert len(done) == 2
+
+
+def test_probe_shed_leaves_no_record_on_the_refusing_replica(tmp_path):
+    # a replica that sheds while the router probes candidates answered
+    # a ROUTING question, not a lifecycle one: no terminal record may
+    # linger there, or a later harvest would merge a stale shed over
+    # the completion the next replica delivers
+    router = make_router(tmp_path, replicas=2, lanes=1,
+                         queue_capacity=1)
+    assert router.submit(Problem(M=10, N=10), request_id="p0") is None
+    # replica holding p0 is full (capacity 1): p1 probes it, gets shed,
+    # lands on the other replica
+    assert router.submit(Problem(M=10, N=10), request_id="p1") is None
+    assert all(
+        "p1" not in rep.scheduler.results for rep in router.replicas
+    )
+    results = router.drain()
+    assert results["p0"].outcome == "completed"
+    assert results["p1"].outcome == "completed"
+    assert router.double_delivered == []
+
+
+def test_anonymous_all_shed_is_recorded_once_under_a_real_id(tmp_path):
+    # the harness submits without ids and discards the return: the
+    # rejection must still land in fleet accounting exactly once,
+    # under one real id — not vanish while each probed replica logs a
+    # phantom shed under its own uuid
+    router = make_router(tmp_path, replicas=2, lanes=1,
+                         queue_capacity=1)
+    for _ in range(2):
+        assert router.submit(Problem(M=10, N=10)) is None
+    shed = router.submit(Problem(M=10, N=10))  # no request_id
+    assert shed is not None and shed.detail == "fleet-backpressure"
+    assert shed.request_id and shed.request_id != "rejected"
+    results = router.drain()
+    sheds = [r for r in results.values() if r.outcome == "shed"]
+    assert len(sheds) == 1 and sheds[0].request_id == shed.request_id
+    assert sum(1 for r in results.values()
+               if r.outcome == "completed") == 2
+
+
+def test_harvest_ledger_catches_cross_replica_double_delivery(tmp_path):
+    # the zero-double detector must live where deliveries pass exactly
+    # once: forge the fencing-failure shape (two replicas both deliver
+    # a terminal record for one id) and the ledger must name it
+    from poisson_ellipse_tpu.serve.request import ServeResult
+
+    router = make_router(tmp_path, replicas=2, lanes=1)
+    for rep in router.replicas:
+        rep.scheduler.results["forged"] = ServeResult(
+            request_id="forged", outcome="completed",
+        )
+    router.harvest()
+    assert router.double_delivered == ["forged"]
+
+
+def test_duplicate_request_id_refused_fleet_wide(tmp_path):
+    router = make_router(tmp_path, replicas=2, lanes=1)
+    assert router.submit(Problem(M=10, N=10), request_id="dup") is None
+    refused = router.submit(Problem(M=12, N=12), request_id="dup")
+    assert refused is not None and refused.outcome == "shed"
+    assert refused.detail == "duplicate-request-id"
+    # the original is untouched and completes exactly once
+    results = router.drain()
+    assert results["dup"].outcome == "completed"
+
+
+def test_retry_of_request_completed_by_dead_replica_is_refused(tmp_path):
+    # the client-retry-after-owner-crash race: replica 0 completes X
+    # and is then killed; the results were collected (evicted); a
+    # client retry of X must be refused as a duplicate — the DEAD
+    # replica's journal is what remembers the delivery, and consulting
+    # it is what keeps the retry from double-completing on a survivor
+    router = make_router(tmp_path, replicas=2, lanes=1)
+    assert router.submit(Problem(M=10, N=10), request_id="retry") is None
+    router.drain()
+    router.collect()  # results evicted, the harness-loop shape
+    owner = next(
+        rep for rep in router.replicas
+        if rep.scheduler.owns_request("retry")
+    )
+    router.kill_replica(owner.replica_id)
+    refused = router.submit(Problem(M=10, N=10), request_id="retry")
+    assert refused is not None and refused.detail == "duplicate-request-id"
+    # and nothing new was admitted anywhere
+    assert all(
+        not rep.scheduler.queue.holds("retry") for rep in router.replicas
+    )
+
+
+def test_fleet_backpressure_shed_allows_resubmission(tmp_path):
+    # "shed ... safe to resubmit after retry_after_s" must hold at the
+    # ROUTER's door too: a fleet-backpressure rejection is not
+    # ownership, and the resubmission supersedes it
+    router = make_router(tmp_path, replicas=2, lanes=1,
+                         queue_capacity=1)
+    for i in range(2):
+        assert router.submit(Problem(M=10, N=10),
+                             request_id=f"fill{i}") is None
+    shed = router.submit(Problem(M=10, N=10), request_id="again")
+    assert shed is not None and shed.detail == "fleet-backpressure"
+    router.drain()  # capacity frees up
+    assert router.submit(Problem(M=10, N=10), request_id="again") is None
+    assert router.drain()["again"].outcome == "completed"
+
+
+def test_death_during_shutdown_adopts_into_draining_survivor(tmp_path):
+    # shutdown races a death: the dead replica's journaled work must be
+    # adopted by a DRAINING survivor (already-acknowledged fleet work is
+    # not a new admission) — never silently abandoned
+    clock = FakeClock()
+    router = make_router(
+        tmp_path, replicas=2, clock=clock, lease_s=1.0, lanes=1, chunk=4,
+    )
+    for i in range(3):
+        assert router.submit(Problem(M=10, N=10),
+                             request_id=f"x{i}") is None
+    for rep in router.replicas:
+        rep.begin_drain()
+    owner = next(
+        rep for rep in router.replicas
+        if rep.scheduler.owns_request("x0")
+    )
+    router.kill_replica(owner.replica_id)
+    results = router.drain()
+    assert {results[f"x{i}"].outcome for i in range(3)} == {"completed"}
+
+
+def test_all_replicas_down_is_classified_exit_9_never_a_hang(tmp_path):
+    router = make_router(tmp_path, replicas=2, lanes=1)
+    assert router.submit(Problem(M=10, N=10), request_id="r0") is None
+    router.drain()
+    router.kill_replica(0)
+    router.kill_replica(1)
+    with pytest.raises(FleetUnavailableError) as exc:
+        router.submit(Problem(M=10, N=10), request_id="r1")
+    assert exc.value.exit_code == 9
+    assert exc.value.retry_after_s is not None
+    assert exc.value.classification == "fleet-unavailable"
+
+
+# -- drain -------------------------------------------------------------------
+
+
+def test_begin_drain_sheds_new_and_finishes_in_flight(tmp_path):
+    router = make_router(tmp_path, replicas=2, lanes=1)
+    for i in range(3):
+        assert router.submit(Problem(M=10, N=10),
+                             request_id=f"d{i}") is None
+    router.step()
+    results = router.shutdown()
+    assert {results[f"d{i}"].outcome for i in range(3)} == {"completed"}
+    # every replica now refuses new work with a redirectable shed, so
+    # the fleet-level answer is the classified exit 9
+    with pytest.raises(FleetUnavailableError):
+        router.submit(Problem(M=10, N=10), request_id="late")
+
+
+def test_draining_scheduler_shed_is_not_recorded_as_terminal():
+    # the drain shed is a redirect for the router, not a lifecycle
+    # event: recording it would double-count the id once another
+    # replica completes the request
+    sched = Scheduler(lanes=1, chunk=8, keep_solutions=False)
+    sched.begin_drain()
+    shed = sched.submit(Problem(M=10, N=10), request_id="redirected")
+    assert shed is not None and shed.outcome == "shed"
+    assert shed.detail == "draining"
+    assert shed.retry_after_s is not None
+    assert "redirected" not in sched.results
+    assert len(sched.queue) == 0
+
+
+# -- chaos: the fleet invariant triple ---------------------------------------
+
+
+def test_fleet_chaos_replica_kill_zero_lost_zero_double(tmp_path):
+    report = run_chaos(
+        n_requests=12, seed=7, replicas=3, chunk=2,
+        journal_path=os.path.join(tmp_path, "journals"),
+        replica_kill=4,
+    )
+    assert report.ok, (
+        f"lost={report.lost} doubled={report.double_completed} "
+        f"unclassified={report.unclassified}"
+    )
+    assert report.killed and report.handoffs >= 1
+    assert report.replicas == 3
+    assert sum(report.counts.values()) == 12
+    # the injected per-request faults REALLY fired (the plan is shared
+    # fleet-wide: nan + oom + the kill = 3) on whichever replica hosted
+    # their victims, and cost them nothing
+    assert report.faults_fired == 3
+    assert report.outcomes["chaos-0002"] == "completed"
+    assert report.outcomes["chaos-0005"] == "completed"
+
+
+def test_fleet_chaos_is_seed_deterministic(tmp_path):
+    kw = dict(n_requests=10, seed=3, replicas=2, chunk=2, replica_kill=3)
+    r1 = run_chaos(journal_path=os.path.join(tmp_path, "c1"), **kw)
+    r2 = run_chaos(journal_path=os.path.join(tmp_path, "c2"), **kw)
+    assert r1.ok and r2.ok
+    assert r1.outcomes == r2.outcomes
+    assert r1.counts == r2.counts
+    assert r1.handoffs == r2.handoffs
+
+
+def test_fleet_chaos_kill_during_handoff(tmp_path):
+    # the adopting survivor dies at the same boundary the first handoff
+    # finished on: journal-first adoption is what keeps the adopted
+    # requests alive through the second kill
+    report = run_chaos(
+        n_requests=12, seed=5, replicas=3, chunk=2,
+        journal_path=os.path.join(tmp_path, "journals"),
+        replica_kill=4, kill_during_handoff=True,
+    )
+    assert report.ok, (
+        f"lost={report.lost} doubled={report.double_completed}"
+    )
+    assert report.handoffs >= 2
+    assert sum(report.counts.values()) == 12
+
+
+def test_fleet_chaos_refuses_single_scheduler_drills_loudly(tmp_path):
+    # drills the fleet path cannot run must be refused, never silently
+    # dropped into a vacuously-green invariant report
+    for kw in (
+        dict(kill_after=3),
+        dict(mesh_kill_request=3),
+        dict(malformed_request=3),
+        dict(degenerate_request=3),
+    ):
+        with pytest.raises(ValueError, match="single-scheduler"):
+            run_chaos(
+                n_requests=8, seed=0, replicas=2,
+                journal_path=os.path.join(tmp_path, "journals"), **kw,
+            )
+
+
+def test_fleet_chaos_kill_during_handoff_needs_three_replicas(tmp_path):
+    # with 2 replicas the double kill is the total-loss drill, not the
+    # handoff drill — refused loudly instead of crashing mid-stream
+    with pytest.raises(ValueError, match="replicas >= 3"):
+        run_chaos(
+            n_requests=8, seed=0, replicas=2,
+            journal_path=os.path.join(tmp_path, "journals"),
+            replica_kill=3, kill_during_handoff=True,
+        )
+
+
+def test_fleet_chaos_zombie_resurrection_stale_write_rejected(tmp_path):
+    report = run_chaos(
+        n_requests=10, seed=4, replicas=2, chunk=2,
+        journal_path=os.path.join(tmp_path, "journals"),
+        zombie=True, nan_request=None, oom_request=None,
+    )
+    assert report.ok, (
+        f"lost={report.lost} doubled={report.double_completed}"
+    )
+    assert report.zombie_drill
+    # the fenced stale write was OBSERVED and REJECTED — the zero-double
+    # pin is a mechanism, not an accident of timing
+    assert report.stale_writes_rejected >= 1
+    assert report.handoffs >= 1
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_fleet_subcommand(tmp_path, capsys):
+    import json
+
+    from poisson_ellipse_tpu.harness.__main__ import main
+
+    trace = tmp_path / "fleet.jsonl"
+    rc = main([
+        "fleet", "--replicas", "2", "--requests", "6",
+        "--grids", "10x10", "--rate", "1000", "--chunk", "4",
+        "--kill-replica-at", "2",
+        "--trace", str(trace), "--json",
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["outcomes"] == {"completed": 6}
+    assert rec["replicas"] == 2
+    assert rec["handoffs"] >= 1
+    assert rec["live_replicas"] == [1]
+    assert obs_trace.validate_file(str(trace)) == []
+    names = {r["name"] for r in obs_trace.read_jsonl(str(trace))}
+    assert "fleet:replica-kill" in names and "fleet_report" in names
+
+
+def test_cli_fleet_rejects_bad_args(capsys):
+    from poisson_ellipse_tpu.harness.__main__ import main
+
+    assert main(["fleet", "--replicas", "0"]) == 2
+    assert main(["fleet", "--requests", "0"]) == 2
+    assert main(["fleet", "--rate", "0"]) == 2
+
+
+# -- SIGTERM graceful shutdown (subprocess) ----------------------------------
+
+
+@pytest.mark.skipif(os.name == "nt", reason="POSIX signals")
+def test_sigterm_drains_serve_gracefully(tmp_path):
+    """SIGTERM on `harness serve` must drain (stop admitting, finish
+    in-flight, flush the trace) and exit 0 — not die mid-stream with
+    the trace tail lost."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace = tmp_path / "sigterm.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "poisson_ellipse_tpu.harness", "serve",
+            "--requests", "500", "--grids", "10x10", "--rate", "3",
+            "--journal", str(tmp_path / "j.json"),
+            "--trace", str(trace), "--json",
+        ],
+        env=env, cwd=repo_root,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # wait until the stream is actually running (first admit on the
+        # trace) so the handler is installed before the signal lands
+        deadline = time.monotonic() + 120.0
+        started = False
+        while time.monotonic() < deadline:
+            if trace.exists() and "serve:admit" in trace.read_text():
+                started = True
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.25)
+        assert started, (
+            f"serve never started (rc={proc.poll()}): "
+            f"{proc.stderr.read() if proc.poll() is not None else ''}"
+        )
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, f"SIGTERM drain exited {proc.returncode}: {err}"
+    # the drain really ran: the trace tail holds the drain event AND
+    # the final report (flushed, not lost with a hard kill)
+    assert obs_trace.validate_file(str(trace)) == []
+    names = [r["name"] for r in obs_trace.read_jsonl(str(trace))]
+    assert "serve:sigterm-drain" in names
+    assert "serve:drain-begin" in names
+    assert "serve_report" in names
+    import json
+
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["drained_on_sigterm"] is True

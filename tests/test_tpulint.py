@@ -27,9 +27,10 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_fifteen_rules():
+def test_registry_has_all_sixteen_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
         "TPU010", "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
+        "TPU016",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -1575,6 +1576,130 @@ def test_tpu015_suppression_comment():
             return float(x)  # tpulint: disable=TPU015
     """
     assert codes_of(src, select=frozenset({"TPU015"})) == []
+
+
+# -- TPU016: wall-clock deadlines -------------------------------------------
+
+
+def test_tpu016_positive_time_time_in_comparison():
+    src = """
+        import time
+
+        def expired(deadline):
+            return time.time() > deadline
+
+        def timed_out(t0, timeout):
+            if time.time() - t0 > timeout:
+                return True
+    """
+    assert codes_of(src, select=frozenset({"TPU016"})) == [
+        "TPU016", "TPU016",
+    ]
+
+
+def test_tpu016_positive_binding_later_compared():
+    src = """
+        import time
+
+        lease_s = 0.5
+        deadline = time.time() + lease_s
+
+        def check(now):
+            return now > deadline
+    """
+    assert codes_of(src, select=frozenset({"TPU016"})) == ["TPU016"]
+
+
+def test_tpu016_positive_self_attribute_deadline():
+    src = """
+        import time
+
+        class Lease:
+            def renew(self, lease_s):
+                self.deadline = time.time() + lease_s
+
+            def expired(self, now):
+                return now > self.deadline
+    """
+    assert codes_of(src, select=frozenset({"TPU016"})) == ["TPU016"]
+
+
+def test_tpu016_negative_lazy_init_guard_is_not_a_deadline():
+    # the lazy-init idiom reads the timestamp's PRESENCE (`is None`),
+    # not the clock's order — a record-only stamp stays silent; so do
+    # equality/membership tests on names that also touch a wall read
+    src = """
+        import time
+
+        class Stamps:
+            t_start = None
+
+            def ensure(self):
+                if self.t_start is None:
+                    self.t_start = time.time()
+
+        seen = {}
+
+        def note(rid):
+            if rid in seen:
+                return
+            seen[rid] = time.time()
+    """
+    assert codes_of(src, select=frozenset({"TPU016"})) == []
+
+
+def test_tpu016_negative_self_attr_scoped_to_the_class():
+    # another class's same-named attribute is a different instance's
+    # slot: a record-only wall-clock stamp in A must not be flagged
+    # because unrelated B compares ITS self.t0 (a monotonic deadline)
+    src = """
+        import time
+
+        class Stamper:
+            def stamp(self):
+                self.t0 = time.time()  # record-only
+
+        class Deadline:
+            def arm(self, budget):
+                self.t0 = time.monotonic() + budget
+
+            def expired(self):
+                return time.monotonic() > self.t0
+    """
+    assert codes_of(src, select=frozenset({"TPU016"})) == []
+
+
+def test_tpu016_negative_recorded_timestamps_and_monotonic():
+    src = """
+        import time
+
+        record = {"t_admit_unix": time.time()}
+
+        def stamp(records, rid):
+            # a record-only wall-clock timestamp whose SUBSCRIPT index
+            # appears in an unrelated membership comparison: the dict
+            # item is not a deadline and must stay silent
+            if rid in records:
+                return
+            records[rid] = {"t": time.time()}
+
+        t0 = time.monotonic()
+
+        def deadline_ok(timeout):
+            # monotonic deadline arithmetic is the fix, never a finding
+            return time.monotonic() - t0 > timeout
+    """
+    assert codes_of(src, select=frozenset({"TPU016"})) == []
+
+
+def test_tpu016_suppression_comment():
+    src = """
+        import time
+
+        def expired(deadline):
+            return time.time() > deadline  # tpulint: disable=TPU016
+    """
+    assert codes_of(src, select=frozenset({"TPU016"})) == []
 
 
 def test_suppression_is_per_code_not_blanket():

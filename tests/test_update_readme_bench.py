@@ -328,6 +328,52 @@ def workspace(tmp_path):
     return tmp_path, readme, artifact
 
 
+def test_fleet_table_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(fleet={
+        "rows": [
+            {"replicas": 1, "lanes": 2, "solves_per_sec": 100.0},
+            {"replicas": 3, "lanes": 2, "solves_per_sec": 115.0},
+        ],
+        "non_decreasing": True,
+        "handoff_p99_s": 0.0025,
+        "handoffs": 1,
+        "adopted": 3,
+        "kill_completed": 24,
+    })
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Replicated fleet" in text
+    assert "| 1 | 2 | 100 |" in text
+    assert "| 3 | 2 | 115 |" in text
+    assert "handoff latency p99 2.50 ms" in text
+    assert "3 request(s) adopted" in text
+    # the kill-drill sentence states only what the artifact carries
+    assert "24 request(s) completed after the kill" in text
+    assert "zero requests lost" not in text
+
+
+def test_fleet_absent_or_failed_is_supported(workspace):
+    # pre-fleet artifacts lack the key; a failed key (no usable rows)
+    # renders nothing; rows without a kill drill render the table alone
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    assert "Replicated fleet" not in readme.read_text()
+    artifact.write_text(json.dumps(make_artifact(fleet={"rows": []})))
+    urb.regenerate(str(readme), str(artifact))
+    assert "Replicated fleet" not in readme.read_text()
+    artifact.write_text(json.dumps(make_artifact(fleet={
+        "rows": [{"replicas": 2, "lanes": 2, "solves_per_sec": 90.0}],
+        "non_decreasing": True,
+        "handoff_p99_s": None,
+    })))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "| 2 | 2 | 90 |" in text
+    assert "Kill drill" not in text
+
+
 def test_regenerate_derives_everything_from_artifact(workspace):
     tmp, readme, artifact = workspace
     summary = urb.regenerate(str(readme), str(artifact), root=str(tmp))

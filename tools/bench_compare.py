@@ -30,6 +30,9 @@ older artifacts predate newer keys, which must never fail the gate):
   percentage points between rounds, or the collective-cadence pin
   (`collectives_identical`) breaking — bench.py's own ≤2% gate bounds
   the absolute; this catches the trend
+- `fleet` rows (keyed by replica count): aggregate `solves_per_sec`
+  through the replicated fleet dropping more than `fleet-agg-pct`, and
+  the `non_decreasing` scaling pin breaking in the new round
 
 Tolerances live in `pyproject.toml [tool.bench_compare]` (shared by the
 CLI and the driver-dryrun smoke gate); built-in defaults apply when the
@@ -69,6 +72,9 @@ DEFAULT_TOLERANCES = {
     # shared CI box), so its band is wider
     "geometry-t-pct": 0.25,
     "geometry-assembly-pct": 0.50,
+    # fleet aggregate solves/sec per replica count: the replicated
+    # serving layer's throughput shares the serving noise floor
+    "fleet-agg-pct": 0.25,
 }
 
 # scalar-row artifact keys carrying {grid, t_solver_s, iters}
@@ -345,6 +351,44 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
             ))
     elif (o_row is None) != (n_row is None):
         notes.append("abft: only in one round, skipped")
+
+    # the fleet key: aggregate solves/sec per replica count (the
+    # replicated layer's throughput story) and the non-decreasing
+    # scaling pin — a new round whose own pin broke is a regression
+    # even if every per-width number stayed inside the band
+    def fleet_rows(rec):
+        fleet = rec.get("fleet")
+        if not isinstance(fleet, dict):
+            return {}
+        return {
+            row["replicas"]: row
+            for row in fleet.get("rows") or []
+            if row.get("replicas") is not None
+        }
+
+    old_fleet, new_fleet = fleet_rows(old), fleet_rows(new)
+    for key in sorted(old_fleet.keys() & new_fleet.keys()):
+        o = old_fleet[key].get("solves_per_sec")
+        n = new_fleet[key].get("solves_per_sec")
+        where_fleet = f"fleet replicas={key}"
+        if one_sided("fleet solves_per_sec", where_fleet, o, n):
+            continue
+        if o and n is not None:
+            limit = tol["fleet-agg-pct"]
+            if n < o * (1.0 - limit):
+                regressions.append(Regression(
+                    "fleet_solves_per_sec", where_fleet, o, n,
+                    f"{(n / o - 1):.0%} > {limit:.0%} aggregate drop",
+                ))
+    if old_fleet and new_fleet:
+        if new.get("fleet", {}).get("non_decreasing") is False:
+            regressions.append(Regression(
+                "fleet_non_decreasing", "fleet", 1, 0,
+                "aggregate solves/sec now DECREASES with replica count "
+                "(the scaling pin broke)",
+            ))
+    elif bool(old_fleet) != bool(new_fleet):
+        notes.append("fleet: only in one round, skipped")
 
     # the geometry key: the composite-domain solve time and the
     # quadrature assembly cost, plus the parity fields as hard pins —

@@ -204,10 +204,61 @@ def table_block(rec: dict, src: str) -> str:
     serving = serving_lines(rec)
     if serving:
         lines += [""] + serving
+    fleet = fleet_lines(rec)
+    if fleet:
+        lines += [""] + fleet
     geometry = geometry_lines(rec)
     if geometry:
         lines += [""] + geometry
     return "\n".join(lines)
+
+
+def fleet_lines(rec: dict) -> list[str]:
+    """Markdown for the artifact's ``fleet`` key (emitted by bench.py
+    since the replicated-serving layer landed): aggregate solves/sec
+    per replica count plus the kill-drill handoff p99. Pre-fleet
+    artifacts lack the key and render without the table; a failed row
+    (no solves_per_sec) is skipped and a missing kill drill renders the
+    table alone — absence and partial are both supported inputs, not
+    errors."""
+    fleet = rec.get("fleet")
+    if not isinstance(fleet, dict):
+        return []
+    rows = [
+        r for r in (fleet.get("rows") or [])
+        if r.get("solves_per_sec") is not None
+        and r.get("replicas") is not None
+    ]
+    if not rows:
+        return []
+    lines = [
+        "Replicated fleet (`fleet/`: lease-fenced scheduler replicas "
+        "behind a shape-affinity router, journal-backed handoff on "
+        "replica death; aggregate throughput regression-gated by "
+        "`tools/bench_compare.py` `fleet-agg-pct`):",
+        "",
+        "| replicas | lanes each | aggregate solves/sec |",
+        "|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['replicas']} | {r.get('lanes', '—')} | "
+            f"{r['solves_per_sec']:g} |"
+        )
+    if fleet.get("handoff_p99_s") is not None:
+        adopted = fleet.get("adopted")
+        completed = fleet.get("kill_completed")
+        lines.append(
+            f"Kill drill: replica 0 SIGKILLed mid-stream — "
+            f"{fleet.get('handoffs', '?')} journal handoff(s)"
+            + (f", {adopted} request(s) adopted" if adopted is not None
+               else "")
+            + f", handoff latency p99 {fleet['handoff_p99_s'] * 1e3:.2f} ms"
+            + (f"; {completed} request(s) completed after the kill"
+               if completed is not None else "")
+            + "."
+        )
+    return lines
 
 
 def geometry_lines(rec: dict) -> list[str]:
