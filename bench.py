@@ -63,6 +63,13 @@ checks):
                 and a composite ellipse-minus-hole solve (converged +
                 discrete maximum principle) as the arbitrary-geometry
                 timing row (``geom.*``).
+  grad        — "grad" key: differentiable solving as a served workload
+                (``diff/``) — grad-solves/sec for a batch of grad=True
+                requests (primal + IFT-adjoint lane pairs) through the
+                scheduler at 400×600, valid iff every gradient lands
+                finite and nonzero, plus the adjoint-vs-primal
+                iteration ratio per published grid (the quoted ~2x
+                cost of a gradient; ``grad-pct`` gated between rounds).
 """
 
 from __future__ import annotations
@@ -705,6 +712,121 @@ def bench_geometry(grid: tuple[int, int] = (400, 600), oracle: int = 546):
     return row, ok
 
 
+def bench_grad(grid: tuple[int, int] = (400, 600), lanes: int = 4,
+               n_requests: int = 8):
+    """The grad key: differentiable solving as a served workload.
+
+    Two facts per round, folded into ``valid``:
+
+    - **grad-solves/sec through the scheduler** — ``n_requests``
+      ``grad=True`` requests (shifted-ellipse geometry, Dirichlet-energy
+      objective) at ``grid`` drained through the continuous-batching
+      scheduler with ``lanes`` candidate lanes: each is a primal + an
+      IFT-adjoint lane solve (``diff.serving``), the batched-candidate
+      traffic shape of a shape-optimization step. Valid iff every
+      request completes with a finite nonzero gradient.
+    - **adjoint-vs-primal iteration ratio per published grid** — one
+      ``diff.adjoint`` gradient per GRIDS row; the adjoint reuses the
+      same operator and preconditioner, so its iteration count should
+      track the primal's (the ratio is the quoted cost of a gradient:
+      ~2x a solve). Valid iff every adjoint converged.
+    """
+    import numpy as np
+
+    from poisson_ellipse_tpu.diff.adjoint import ImplicitSolver
+    from poisson_ellipse_tpu.geom import sdf
+    from poisson_ellipse_tpu.serve.request import ServeRequest
+    from poisson_ellipse_tpu.serve.scheduler import Scheduler
+
+    M, N = grid
+    p = Problem(M=M, N=N)
+    geometry = {"kind": "ellipse", "cx": 0.05, "cy": -0.02, "rx": 0.9,
+                "ry": 0.45}
+
+    sched = Scheduler(lanes=lanes, chunk=32, queue_capacity=n_requests + 1,
+                      keep_solutions=False)
+    # warm the bucket executable before the timed stream (the compile
+    # belongs to the coldstart key, not this one)
+    warm = ServeRequest(problem=p, grad=True, geometry=dict(geometry),
+                        objective={"kind": "energy"}, request_id="grad-warm")
+    sched.submit_request(warm)
+    sched.drain()
+    sched.collect()
+
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        req = ServeRequest(
+            problem=p, grad=True, geometry=dict(geometry),
+            objective={"kind": "energy"}, request_id=f"grad-{i:03d}",
+        )
+        sched.submit_request(req)
+    results = sched.drain()
+    wall = time.perf_counter() - t0
+
+    ok = True
+    for i in range(n_requests):
+        res = results.get(f"grad-{i:03d}")
+        good = (
+            res is not None and res.outcome == "completed"
+            and res.grad is not None
+            and np.all(np.isfinite(res.grad))
+            and float(np.abs(np.asarray(res.grad)).max()) > 0.0
+        )
+        ok &= bool(good)
+    gps = n_requests / wall if wall > 0 else None
+
+    # the per-grid adjoint/primal iteration ratio (one gradient per
+    # published grid; the solver quotes both solves in `last`)
+    rows = []
+    import jax.numpy as jnp
+
+    template = sdf.Ellipse(cx=0.05, cy=-0.02, rx=0.9, ry=0.45)
+    for gm, gn, _oracle, _ref in GRIDS:
+        solver = ImplicitSolver(Problem(M=gm, N=gn), template,
+                                engine="xla")
+        g = jax.grad(
+            lambda q: jnp.sum(solver.solve(q) ** 2)
+        )({"shape": jnp.asarray(sdf.params_of(template),
+                                solver.dtype)})
+        quotes = list(solver.last)
+        ok &= (
+            len(quotes) == 2
+            and all(q["converged"] for q in quotes)
+            and bool(np.all(np.isfinite(np.asarray(g["shape"]))))
+        )
+        primal_it = quotes[0]["iters"] if quotes else 0
+        adjoint_it = quotes[1]["iters"] if len(quotes) > 1 else 0
+        rows.append({
+            "grid": [gm, gn],
+            "primal_iters": primal_it,
+            "adjoint_iters": adjoint_it,
+            "ratio": round(adjoint_it / max(primal_it, 1), 3),
+        })
+        note(
+            f"  [grad] {gm}x{gn}: primal {primal_it} + adjoint "
+            f"{adjoint_it} iters (ratio "
+            f"{rows[-1]['ratio']})"
+        )
+
+    row = {
+        "grid": [M, N],
+        "lanes": lanes,
+        "n_requests": n_requests,
+        "grad_solves_per_sec": (
+            round(gps, 3) if gps is not None else None
+        ),
+        "wall_s": round(wall, 4),
+        "rows": rows,
+        "valid": bool(ok),
+    }
+    note(
+        f"  [grad] {M}x{N} x{n_requests} grad requests over {lanes} "
+        f"lanes: {row['grad_solves_per_sec']} grad-solves/s "
+        + ("— OK" if ok else "— GRAD CHECK FAILED")
+    )
+    return row, ok
+
+
 # the ABFT healthy-path overhead gate: checks-on vs checks-off T_solver
 # at the headline grid (percent; tools/bench_compare.py diffs the
 # measured overhead between rounds under [tool.bench_compare] abft-pp)
@@ -1221,9 +1343,12 @@ def main() -> int:
     # geometry study: SDF-quadrature-vs-closed-form parity + overhead
     # and the composite-domain timing row (f32, pre-f64-flip)
     geom_row, okg = bench_geometry()
+    # differentiable solving: grad-solves/sec through the scheduler +
+    # adjoint-vs-primal iteration ratio per grid (f32, pre-f64-flip)
+    grad_row, okgr = bench_grad()
     all_ok &= (
         ok2 & okn & ok8 & okp & okpc & okt & okcs & oksv & okfl & oke
-        & okc & okl & oks & okr & oka & okg
+        & okc & okl & oks & okr & oka & okg & okgr
     )
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
@@ -1284,6 +1409,11 @@ def main() -> int:
         # err, ±2 iters), host assembly overhead, and the composite-
         # domain (ellipse-minus-hole) solve row (geom.*)
         "geometry": geom_row,
+        # differentiable solving (diff/): grad-solves/sec through the
+        # scheduler (batched candidate lanes; gated by
+        # tools/bench_compare.py [tool.bench_compare] grad-pct) +
+        # adjoint-vs-primal iteration ratio per published grid
+        "grad": grad_row,
         "f64": f64_row,
     }
     trace_event("bench_artifact", **record)

@@ -1,0 +1,45 @@
+"""Differentiable solving: IFT adjoints through the converged PCG solve.
+
+The stack's second mathematical capability: gradients of scalar
+functionals of the converged solution ``u(θ)`` with respect to problem
+parameters θ — SDF geometry parameters, the source field, the
+penetration parameter ε — obtained NOT by backpropagating through
+thousands of PCG iterations (unbounded memory, no reverse rule for
+``lax.while_loop``) but via the implicit function theorem: at
+convergence ``A(θ) u = b(θ)`` with A symmetric positive definite
+(PAPER.md §0), so for a loss L(u)
+
+    dL/dθ = −λᵀ (∂A/∂θ · u − ∂b/∂θ),    A λ = ∂L/∂u,
+
+i.e. **one extra PCG solve with the exact same operator** — every
+engine, preconditioner (``mg``), guard and sharded form is reused
+as-is (Christianson's fixed-point adjoint; Blondel et al.'s modular
+implicit differentiation, as in ``jaxopt``).
+
+- :mod:`.assembly` — the θ→(a, b, rhs) assembly path made traceable
+  end-to-end: a differentiable linear-interpolation face quadrature
+  over any ``geom.sdf`` composition (the closed-form ellipse is
+  differentiable today via ``models.ellipse.safe_sqrt``).
+- :mod:`.adjoint` — :class:`~poisson_ellipse_tpu.diff.adjoint.
+  ImplicitSolver` / :func:`~poisson_ellipse_tpu.diff.adjoint.
+  solve_implicit`: the ``jax.custom_vjp`` wrapper whose forward is a
+  registered engine's converged solve and whose backward runs the
+  adjoint PCG (same operator, same ``precond`` hook, tolerance tied to
+  the primal δ), plus a ``lax.custom_linear_solve`` mode for
+  forward-over-reverse HVPs.
+- :mod:`.objectives` — reference functionals (Dirichlet energy,
+  boundary flux, L2 misfit) and their JSON spec form for serving.
+- :mod:`.optimize` — gradient descent / L-BFGS over parameter vectors,
+  shipping the two acceptance workloads: ellipse-recovers-itself
+  inverse geometry and inverse-source recovery.
+- :mod:`.serving` — the ``ServeRequest(grad=True)`` request kind: the
+  primal and adjoint solves scheduled as ordinary chunked lanes
+  (retire-and-refill applies), terminally completing with
+  ``(value, grad)``; journal replay reproduces the identical gradient.
+"""
+
+from poisson_ellipse_tpu.diff.adjoint import (  # noqa: F401
+    ImplicitSolver,
+    solve_implicit,
+)
+from poisson_ellipse_tpu.diff.assembly import assemble_theta  # noqa: F401

@@ -1,0 +1,124 @@
+"""Reference functionals of the converged solution, and their wire form.
+
+Each functional is a plain differentiable ``jnp`` expression of the
+solution grid (and, where stated, the operands), so ``jax.grad`` chains
+it with :mod:`diff.adjoint`'s implicit solve — the cotangent ∂L/∂u it
+produces is exactly the adjoint solve's right-hand side.
+
+The JSON spec form (:func:`objective_from_spec`) is what a
+``ServeRequest(grad=True)`` carries and the journal replays: a flat
+dict with a ``kind`` and kind-specific fields, rebuilt into a closure
+``fn(u, a, b, rhs) -> scalar`` at dispatch time.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.reduction import grid_dot
+from poisson_ellipse_tpu.ops.stencil import apply_a
+
+OBJECTIVE_KINDS = ("energy", "flux", "l2", "mean")
+
+
+def dirichlet_energy(problem: Problem, u, a, b):
+    """½ ⟨u, A u⟩ (h1·h2-weighted) — the Dirichlet energy of the
+    discrete solution; at convergence equal to ½ ⟨u, b⟩ (compliance/2),
+    the canonical shape-optimisation objective."""
+    h1 = jnp.asarray(problem.h1, u.dtype)
+    h2 = jnp.asarray(problem.h2, u.dtype)
+    return 0.5 * grid_dot(u, apply_a(u, a, b, h1, h2), h1, h2)
+
+
+def boundary_flux(problem: Problem, u, a, b, weight):
+    """−⟨A u, w⟩ (h1·h2-weighted) for a fixed window field ``w``: the
+    adjoint-consistent evaluation of the flux of u through the support
+    boundary of ``w`` (w ≡ 1 on a subregion measures the net flux out
+    of it — integration by parts moves the normal derivative onto the
+    window's edge)."""
+    h1 = jnp.asarray(problem.h1, u.dtype)
+    h2 = jnp.asarray(problem.h2, u.dtype)
+    return -grid_dot(apply_a(u, a, b, h1, h2), weight, h1, h2)
+
+
+def l2_misfit(problem: Problem, u, target, mask=None):
+    """½ Σ mask·(u − target)² · h1·h2 — the data-misfit functional of
+    the inverse problems (``mask=None`` weighs every node; iterates are
+    zero off-interior so this is the interior misfit)."""
+    d = u - target
+    if mask is not None:
+        d = d * mask
+    return 0.5 * jnp.sum(d * d) * problem.h1 * problem.h2
+
+
+def mean_value(problem: Problem, u):
+    """Mean of u over the interior nodes — the cheapest smooth probe
+    functional (serving's default-adjacent choice for drills)."""
+    return jnp.mean(u[1:-1, 1:-1])
+
+
+def _grid_of(value, field: str) -> np.ndarray:
+    """A spec field as a finite float64 array, every malformation
+    classified as ``ValueError`` — numpy raises ``TypeError`` for
+    non-numeric nested payloads, which would escape the admission
+    gate's classification otherwise."""
+    try:
+        arr = np.asarray(value, np.float64)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"objective {field!r} must be a numeric grid: {e}")
+    if not np.all(np.isfinite(arr)):
+        raise ValueError(f"objective {field!r} must be finite")
+    return arr
+
+
+def objective_from_spec(spec: dict | None, problem: Problem):
+    """Build ``fn(u, a, b, rhs) -> scalar`` from a request's objective
+    spec. ``None`` defaults to the Dirichlet energy. Malformed specs
+    raise ``ValueError`` (the serving layer classifies at admission).
+
+    Kinds:
+      - ``{"kind": "energy"}`` — :func:`dirichlet_energy`
+      - ``{"kind": "flux", "weight": [[...]]}`` — :func:`boundary_flux`
+        (weight defaults to the all-ones interior window)
+      - ``{"kind": "l2", "target": [[...]]}`` — :func:`l2_misfit`
+      - ``{"kind": "mean"}`` — :func:`mean_value`
+    """
+    if spec is None:
+        spec = {"kind": "energy"}
+    if not isinstance(spec, dict):
+        raise ValueError(f"objective spec must be a dict, got {type(spec)}")
+    kind = spec.get("kind", "energy")
+    if kind == "energy":
+        return lambda u, a, b, rhs: dirichlet_energy(problem, u, a, b)
+    if kind == "flux":
+        w = spec.get("weight")
+        if w is None:
+            weight = jnp.zeros(problem.node_shape).at[1:-1, 1:-1].set(1.0)
+        else:
+            weight = jnp.asarray(_grid_of(w, "weight"))
+            if weight.shape != problem.node_shape:
+                raise ValueError(
+                    f"flux weight shape {weight.shape} != node grid "
+                    f"{problem.node_shape}"
+                )
+        return lambda u, a, b, rhs: boundary_flux(problem, u, a, b,
+                                                  weight.astype(u.dtype))
+    if kind == "l2":
+        t = spec.get("target")
+        if t is None:
+            raise ValueError("objective kind 'l2' needs a 'target' grid")
+        target = jnp.asarray(_grid_of(t, "target"))
+        if target.shape != problem.node_shape:
+            raise ValueError(
+                f"l2 target shape {target.shape} != node grid "
+                f"{problem.node_shape}"
+            )
+        return lambda u, a, b, rhs: l2_misfit(problem, u,
+                                              target.astype(u.dtype))
+    if kind == "mean":
+        return lambda u, a, b, rhs: mean_value(problem, u)
+    raise ValueError(
+        f"unknown objective kind {kind!r} (choose from {OBJECTIVE_KINDS})"
+    )

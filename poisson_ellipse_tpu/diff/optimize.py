@@ -1,0 +1,271 @@
+"""First-order optimisation over solve parameters + the two acceptance
+workloads.
+
+The optimisers are deliberately small and dependency-free (the
+container has no scipy contract): a backtracking gradient descent and
+a two-loop-recursion L-BFGS with Armijo line search, both operating on
+flat float64 numpy vectors via ``jax.flatten_util.ravel_pytree`` —
+every iterate is a concrete host vector, so a step can be projected
+(radii kept positive) and re-serialised to a valid JSON spec
+(``geom.sdf.with_params`` → ``to_spec``) without drift.
+
+Workloads (both seeded-deterministic; the acceptance criteria of
+ROADMAP item 1):
+
+- :func:`recover_ellipse` — ellipse-recovers-itself inverse geometry:
+  observations are the converged solution of a reference ellipse; a
+  randomly perturbed parameter vector is optimised under the L2 misfit
+  until the true parameters are recovered (≤1e-3 relative).
+- :func:`recover_source` — inverse-source recovery: the source field
+  (one value per interior node) is recovered from the solution it
+  produced, the misfit dropping ≥100×.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from poisson_ellipse_tpu.diff.adjoint import ImplicitSolver
+from poisson_ellipse_tpu.diff.objectives import l2_misfit
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.obs import trace as obs_trace
+
+# Armijo backtracking: accept f(x + t·d) ≤ f(x) + C1·t·⟨g, d⟩, halving
+# t at most BACKTRACK_MAX times before declaring the direction dead
+_C1 = 1e-4
+_BACKTRACK_MAX = 30
+
+
+@dataclasses.dataclass
+class OptResult:
+    """One optimisation run's outcome (vectors are float64 numpy)."""
+
+    x: np.ndarray
+    value: float
+    n_iters: int
+    n_evals: int
+    converged: bool
+    history: list
+
+
+def _minimize(value_and_grad: Callable, x0: np.ndarray, steps: int,
+              method: str = "lbfgs", project: Optional[Callable] = None,
+              gtol: float = 1e-10, memory: int = 10) -> OptResult:
+    """Minimise a flat-vector objective by L-BFGS (two-loop recursion)
+    or projected gradient descent, Armijo-backtracked either way."""
+    x = np.asarray(x0, np.float64).copy()
+    if project is not None:
+        x = project(x)
+    evals = [0]
+
+    def vg(z):
+        evals[0] += 1
+        v, g = value_and_grad(z)
+        return float(v), np.asarray(g, np.float64)
+
+    f, g = vg(x)
+    history = [f]
+    s_list: list[np.ndarray] = []
+    y_list: list[np.ndarray] = []
+    converged = False
+    it = 0
+    for it in range(1, steps + 1):
+        if np.linalg.norm(g) <= gtol:
+            converged = True
+            break
+        if method == "lbfgs" and s_list:
+            d = _two_loop(g, s_list, y_list)
+        else:
+            # first step / plain GD: scale so the initial trial is O(1)
+            # in parameter space, not O(‖g‖)
+            d = -g / max(np.linalg.norm(g), 1e-30)
+        gd = float(g @ d)
+        if gd >= 0.0:  # stale curvature pairs: reset to steepest descent
+            s_list.clear()
+            y_list.clear()
+            d = -g / max(np.linalg.norm(g), 1e-30)
+            gd = float(g @ d)
+        t = 1.0
+        f_new, g_new, x_new = f, g, x
+        ok = False
+        for _ in range(_BACKTRACK_MAX):
+            x_try = x + t * d
+            if project is not None:
+                x_try = project(x_try)
+            f_try, g_try = vg(x_try)
+            if np.isfinite(f_try) and f_try <= f + _C1 * t * gd:
+                f_new, g_new, x_new = f_try, g_try, x_try
+                ok = True
+                break
+            t *= 0.5
+        if not ok:
+            converged = np.linalg.norm(g) <= max(gtol, 1e-8 * abs(f) + 1e-12)
+            break
+        if method == "lbfgs":
+            s = x_new - x
+            y = g_new - g
+            if float(s @ y) > 1e-14 * np.linalg.norm(s) * np.linalg.norm(y):
+                s_list.append(s)
+                y_list.append(y)
+                if len(s_list) > memory:
+                    s_list.pop(0)
+                    y_list.pop(0)
+        x, f, g = x_new, f_new, g_new
+        history.append(f)
+    return OptResult(x=x, value=f, n_iters=it, n_evals=evals[0],
+                     converged=converged, history=history)
+
+
+def _two_loop(g: np.ndarray, s_list, y_list) -> np.ndarray:
+    """The L-BFGS two-loop recursion: H·(−g) from the stored (s, y)."""
+    q = g.copy()
+    alphas = []
+    for s, y in zip(reversed(s_list), reversed(y_list)):
+        rho = 1.0 / float(y @ s)
+        a = rho * float(s @ q)
+        alphas.append((a, rho, s, y))
+        q -= a * y
+    s, y = s_list[-1], y_list[-1]
+    q *= float(s @ y) / float(y @ y)
+    for a, rho, s, y in reversed(alphas):
+        beta = rho * float(y @ q)
+        q += (a - beta) * s
+    return -q
+
+
+def minimize_params(loss_fn: Callable, p0: dict, steps: int = 50,
+                    method: str = "lbfgs",
+                    project: Optional[Callable] = None) -> OptResult:
+    """Minimise ``loss_fn(params)`` (params the diff pytree) from
+    ``p0``: ravel, optimise the flat vector, return the
+    :class:`OptResult` (``res.x`` in ``ravel_pytree`` order).
+    ``project`` acts on the raveled vector (e.g. positivity of
+    radii)."""
+    flat0, unravel = ravel_pytree(jax.tree.map(jnp.asarray, p0))
+    vg = jax.value_and_grad(lambda z: loss_fn(unravel(z)))
+
+    def value_and_grad(z):
+        v, g = vg(jnp.asarray(z))
+        return v, ravel_pytree(g)[0]
+
+    return _minimize(value_and_grad, np.asarray(flat0), steps=steps,
+                     method=method, project=project)
+
+
+# --------------------------------------------------------------------------
+# acceptance workloads
+# --------------------------------------------------------------------------
+
+
+def recover_ellipse(grid: tuple[int, int] = (24, 24), engine: str = "xla",
+                    seed: int = 0, perturb: float = 0.04, steps: int = 60,
+                    delta: float = 1e-11, samples: int = 8) -> dict:
+    """Ellipse-recovers-itself: perturbed (cx, cy, rx, ry) optimised
+    back to the reference ellipse under the L2 misfit of the solution.
+
+    Returns a JSON-able report: the true/initial/recovered parameter
+    vectors, relative recovery error (acceptance ≤ 1e-3), misfit drop,
+    and the recovered shape re-serialised as a valid JSON spec (the
+    ``params_of``/``with_params`` round trip under load).
+    """
+    from poisson_ellipse_tpu.geom import sdf as geom_sdf
+
+    problem = Problem(M=grid[0], N=grid[1], delta=delta)
+    template = geom_sdf.Ellipse()
+    solver = ImplicitSolver(problem, template, engine=engine,
+                            samples=samples)
+    true = geom_sdf.params_of(template)
+    target = np.asarray(solver.solve({"shape": jnp.asarray(true)}))
+
+    rng = np.random.default_rng(seed)
+    scale = np.maximum(np.abs(true), 0.25)
+    x0 = true + perturb * scale * rng.uniform(-1.0, 1.0, size=true.shape)
+
+    def loss(params):
+        u = solver.solve(params)
+        return l2_misfit(problem, u, jnp.asarray(target))
+
+    def project(z):
+        z = z.copy()
+        z[2:] = np.maximum(z[2:], 0.05)  # radii stay positive
+        return z
+
+    res = minimize_params(loss, {"shape": x0}, steps=steps,
+                          method="lbfgs", project=project)
+    rel_err = float(np.max(np.abs(res.x - true) / scale))
+    spec = geom_sdf.to_spec(geom_sdf.with_params(template, res.x))
+    report = {
+        "workload": "recover-ellipse",
+        "grid": list(grid),
+        "engine": engine,
+        "seed": seed,
+        "true": true.tolist(),
+        "initial": x0.tolist(),
+        "recovered": res.x.tolist(),
+        "recovered_spec": spec,
+        "rel_err": rel_err,
+        "misfit_initial": res.history[0],
+        "misfit_final": res.value,
+        "n_iters": res.n_iters,
+        "n_evals": res.n_evals,
+        "ok": bool(rel_err <= 1e-3),
+    }
+    obs_trace.event("diff:recover-ellipse", **{
+        k: report[k] for k in ("grid", "engine", "seed", "rel_err", "ok")
+    })
+    return report
+
+
+def recover_source(grid: tuple[int, int] = (16, 16), engine: str = "xla",
+                   seed: int = 0, steps: int = 80,
+                   delta: float = 1e-11, samples: int = 8) -> dict:
+    """Inverse-source recovery: the per-node source field behind an
+    observed solution, recovered from a flat initial guess; acceptance
+    is the L2 misfit dropping ≥ 100×."""
+    from poisson_ellipse_tpu.geom import sdf as geom_sdf
+
+    problem = Problem(M=grid[0], N=grid[1], delta=delta)
+    template = geom_sdf.Ellipse()
+    solver = ImplicitSolver(problem, template, engine=engine,
+                            samples=samples)
+
+    # the hidden truth: a smooth off-centre blob over the constant load
+    rng = np.random.default_rng(seed)
+    cx, cy = rng.uniform(-0.3, 0.3), rng.uniform(-0.15, 0.15)
+    x = problem.a1 + np.arange(problem.M + 1) * problem.h1
+    y = problem.a2 + np.arange(problem.N + 1) * problem.h2
+    xx, yy = x[:, None], y[None, :]
+    s_true = 1.0 + 2.0 * np.exp(-(((xx - cx) / 0.3) ** 2
+                                  + ((yy - cy) / 0.2) ** 2))
+    target = np.asarray(solver.solve({"source": jnp.asarray(s_true)}))
+
+    def loss(params):
+        u = solver.solve(params)
+        return l2_misfit(problem, u, jnp.asarray(target))
+
+    s0 = np.ones_like(s_true)
+    res = minimize_params(loss, {"source": s0}, steps=steps,
+                          method="lbfgs")
+    drop = float(res.history[0] / max(res.value, 1e-300))
+    report = {
+        "workload": "recover-source",
+        "grid": list(grid),
+        "engine": engine,
+        "seed": seed,
+        "misfit_initial": res.history[0],
+        "misfit_final": res.value,
+        "misfit_drop": drop,
+        "n_iters": res.n_iters,
+        "n_evals": res.n_evals,
+        "ok": bool(drop >= 100.0),
+    }
+    obs_trace.event("diff:recover-source", **{
+        k: report[k] for k in ("grid", "engine", "seed", "misfit_drop", "ok")
+    })
+    return report

@@ -1,0 +1,176 @@
+"""The ``grad=True`` request kind: IFT adjoints as ordinary lanes.
+
+A gradient request is TWO solves with the same operator — the primal
+and the adjoint — so the scheduler runs it as two consecutive lane
+occupancies of the continuous-batching machinery it already has:
+
+  1. **primal** — the request's differentiably-assembled operands
+     (``diff.assembly``) are pad-and-mask embedded into a bucket lane
+     exactly like any other request; retire-and-refill applies.
+  2. at the primal's converged chunk boundary the host evaluates the
+     objective's value and cotangent ū = ∂L/∂u (one ``jax.value_and_
+     grad`` of the functional — no solve), normalises it (the adjoint
+     tolerance contract of ``diff.adjoint``), and re-queues the request
+     as its **adjoint** stage: same (a, b), RHS = ū/‖ū‖ — an ordinary
+     lane again, on whatever lane frees up next.
+  3. at the adjoint's converged boundary the host contracts
+     λ = ‖ū‖·(lane solution) against ∂(A u − b)/∂θ via ``jax.vjp`` of
+     the traceable assembly, and the request terminally completes with
+     ``(value, grad)``.
+
+Durability: nothing about a half-done gradient is journaled — the
+admit record IS the promise. A kill mid-primal or mid-adjoint replays
+the request from scratch on restart; the recompute is deterministic
+(fixed params → fixed operands → fixed solves), so the replayed
+gradient is IDENTICAL — the chaos invariant of the grad kind. A lane
+fault / retry resets the stage to primal the same way.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.diff import assembly as diff_assembly
+from poisson_ellipse_tpu.diff.objectives import objective_from_spec
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.stencil import apply_a
+
+
+class GradJob:
+    """Host-side lifecycle state of one grad request (never journaled;
+    rebuilt deterministically from the request spec on replay)."""
+
+    def __init__(self, req, samples: int = diff_assembly.DEFAULT_SAMPLES):
+        from poisson_ellipse_tpu.geom import sdf as geom_sdf
+
+        self.problem: Problem = req.problem
+        self.samples = samples
+        shape = req.geometry_sdf()
+        self.template = shape if shape is not None else geom_sdf.Ellipse()
+        self.params = {
+            "shape": jnp.asarray(geom_sdf.params_of(self.template))
+        }
+        self.objective = objective_from_spec(req.objective, self.problem)
+        a, b, rhs = diff_assembly.operands_of(
+            self.problem, self.template, self.params, samples=samples,
+            dtype=diff_assembly.default_dtype(),
+        )
+        self.a = np.asarray(a)
+        self.b = np.asarray(b)
+        self.rhs = np.asarray(rhs)
+        self.stage = "primal"
+        self.u: np.ndarray | None = None
+        self.value: float | None = None
+        self.ubar_norm: float | None = None
+        self.adj_rhs: np.ndarray | None = None
+        self.primal_iters = 0
+        self.adjoint_iters = 0
+
+    def reset(self) -> None:
+        """Back to the primal stage (a retried/faulted lane's carry is
+        gone; the recompute is deterministic either way)."""
+        self.stage = "primal"
+        self.u = None
+        self.value = None
+        self.ubar_norm = None
+        self.adj_rhs = None
+        self.primal_iters = 0
+        self.adjoint_iters = 0
+
+    def embed(self, bucket: tuple[int, int], np_dtype):
+        """The current stage's pad-and-mask bucket embedding — the ONE
+        layout (``serve.scheduler.embed_operands``) every lane uses,
+        with the stage's RHS (primal load / normalised cotangent)."""
+        from poisson_ellipse_tpu.serve.scheduler import embed_operands
+
+        rhs = self.rhs if self.stage == "primal" else self.adj_rhs
+        return embed_operands(self.problem, bucket, np_dtype,
+                              self.a, self.b, rhs)
+
+    def absorb_primal(self, u: np.ndarray, iters: int) -> bool:
+        """Record the converged primal; compute the objective value and
+        its cotangent. Returns True when an adjoint solve is pending
+        (False: zero cotangent — the gradient is exactly zero and the
+        request can complete without a second solve)."""
+        self.u = np.asarray(u, np.float64)
+        self.primal_iters = iters
+        value, ubar = jax.value_and_grad(
+            lambda uu: self.objective(
+                uu, jnp.asarray(self.a), jnp.asarray(self.b),
+                jnp.asarray(self.rhs),
+            )
+        )(jnp.asarray(self.u))
+        self.value = float(value)
+        ubar = np.asarray(ubar, np.float64)
+        nrm = float(np.sqrt(np.sum(ubar * ubar)))
+        if nrm == 0.0:
+            self.ubar_norm = 0.0
+            return False
+        self.ubar_norm = nrm
+        self.adj_rhs = ubar / nrm
+        self.stage = "adjoint"
+        return True
+
+    def zero_grad(self):
+        """The gradient vector of a zero cotangent."""
+        return np.zeros_like(np.asarray(self.params["shape"]))
+
+    def finish(self, lam_unit: np.ndarray, iters: int) -> np.ndarray:
+        """Contract the converged adjoint lane solution into the
+        gradient w.r.t. the request's shape parameters: one ``jax.grad``
+        of the Lagrangian L(u, θ) − λᵀ(A(θ)u − b(θ)) at FIXED (u, λ) —
+        the λ-contraction of the IFT plus the objective's explicit
+        θ-dependence (the Dirichlet energy reads A(θ) directly)."""
+        self.adjoint_iters = iters
+        dtype = diff_assembly.default_dtype()
+        lam = jnp.asarray(
+            np.asarray(lam_unit, np.float64) * self.ubar_norm, dtype
+        )
+        problem = self.problem
+        h1 = jnp.asarray(problem.h1, dtype)
+        h2 = jnp.asarray(problem.h2, dtype)
+        u = jnp.asarray(self.u, dtype)
+
+        def lagrangian(params):
+            a2, b2, r2 = diff_assembly.operands_of(
+                problem, self.template, params, samples=self.samples,
+                dtype=dtype,
+            )
+            residual = apply_a(u, a2, b2, h1, h2) - r2
+            return (
+                self.objective(u, a2, b2, r2)
+                - jnp.sum(lam * residual)
+            )
+
+        pbar = jax.grad(lagrangian)(self.params)
+        return np.asarray(pbar["shape"], np.float64)
+
+
+def solve_grad_direct(req, samples: int = diff_assembly.DEFAULT_SAMPLES,
+                      dtype=None):
+    """The un-laned fallback: value and gradient via ``diff.adjoint``'s
+    implicit solver on the xla engine — the grad request's analogue of
+    the scheduler's guarded single solve (the retry ladder's last
+    rung). Deterministic, so a fallback completion quotes the same
+    gradient a lane completion would (up to the engines' documented
+    ±ulp reduction-order differences)."""
+    from poisson_ellipse_tpu.diff.adjoint import ImplicitSolver
+    from poisson_ellipse_tpu.geom import sdf as geom_sdf
+
+    shape = req.geometry_sdf()
+    template = shape if shape is not None else geom_sdf.Ellipse()
+    solver = ImplicitSolver(req.problem, template, engine="xla",
+                            dtype=dtype, samples=samples)
+    objective = objective_from_spec(req.objective, req.problem)
+    params = {"shape": jnp.asarray(geom_sdf.params_of(template))}
+
+    def loss(p):
+        a, b, rhs = solver.operands(p)
+        u = solver.solve_operands(a, b, rhs)
+        return objective(u, a, b, rhs)
+
+    value, grad = jax.value_and_grad(loss)(params)
+    iters = sum(e.get("iters", 0) for e in solver.last)
+    return float(value), np.asarray(grad["shape"], np.float64), iters

@@ -225,6 +225,38 @@ def check_guard_recoverability(problem: Problem, shape) -> str:
     return "finite-result"
 
 
+def check_param_roundtrip(shape) -> int:
+    """The spec↔pytree round-trip invariant of the diff/ surface: the
+    parameter vector read out of a shape tree (``params_of``) rebuilds
+    THE SAME tree (``with_params``) — spec-equal after a JSON wire
+    round trip, so an optimizer step re-serialises without drift — and
+    perturbed parameters still produce a valid, re-parseable JSON spec.
+    Returns the parameter count."""
+    import json as _json
+
+    params = geom_sdf.params_of(shape)
+    if params.shape != (geom_sdf.n_params(shape),):
+        raise AssertionError(
+            f"params_of length {params.shape} != n_params "
+            f"{geom_sdf.n_params(shape)}"
+        )
+    rebuilt = geom_sdf.with_params(shape, params)
+    spec0 = _json.dumps(geom_sdf.to_spec(shape), sort_keys=True)
+    spec1 = _json.dumps(geom_sdf.to_spec(rebuilt), sort_keys=True)
+    if spec0 != spec1:
+        raise AssertionError(
+            f"params round trip drifted:\n  {spec0}\n  {spec1}"
+        )
+    # a perturbed vector must still serialise to RFC JSON and re-parse
+    # through the gate's first rung (from_spec) without structural loss
+    bumped = geom_sdf.with_params(shape, params + 1e-3)
+    wire = _json.loads(_json.dumps(geom_sdf.to_spec(bumped)))
+    reparsed = geom_sdf.from_spec(wire)
+    if not (geom_sdf.params_of(reparsed) == geom_sdf.params_of(bumped)).all():
+        raise AssertionError("perturbed spec re-parse lost parameters")
+    return int(params.size)
+
+
 def run_fuzz(n_cases: int = DEFAULT_CASES, seed: int = 0,
              grid: tuple[int, int] = DEFAULT_GRID,
              solve_budget: int = 4) -> dict:
@@ -241,7 +273,8 @@ def run_fuzz(n_cases: int = DEFAULT_CASES, seed: int = 0,
     problem = Problem(M=grid[0], N=grid[1])
     report: dict = {
         "seed": seed, "cases": n_cases, "grid": list(grid),
-        "accepted": 0, "rejected": {}, "solved": 0, "details": [],
+        "accepted": 0, "rejected": {}, "solved": 0, "roundtrips": 0,
+        "details": [],
     }
     solves_left = solve_budget
     refinement_done = False
@@ -275,6 +308,11 @@ def run_fuzz(n_cases: int = DEFAULT_CASES, seed: int = 0,
             else random_shape(rng, symmetric=symmetric)
         )
         entry["spec"] = geom_sdf.to_spec(shape)
+        # every structurally-valid tree must survive the diff/ surface's
+        # spec↔pytree round trip (params_of/with_params), admissible or
+        # not — inadmissibility is a domain fact, not a wire-form one
+        entry["n_params"] = check_param_roundtrip(shape)
+        report["roundtrips"] += 1
         try:
             geom_validate.validate(problem, shape)
         except InvalidGeometryError as e:

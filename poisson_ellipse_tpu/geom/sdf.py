@@ -195,6 +195,123 @@ def is_inside(shape, x, y, xp=jnp):
     return shape(x, y, xp) < 0.0
 
 
+# --------------------------------------------------------------------------
+# spec ↔ parameter-vector round trip (the differentiable-solving surface)
+# --------------------------------------------------------------------------
+#
+# Every numeric leaf of a shape tree, in a DETERMINISTIC order (the
+# dataclass field order of each node, children in composition order —
+# exactly the order ``to_spec`` serialises). ``params_of`` reads them out
+# as a float64 vector; ``with_params`` rebuilds the same tree around new
+# values, which may be traced scalars (``jax.grad`` over geometry walks
+# through here) or plain numbers (an optimizer step re-serialising to a
+# valid JSON spec — plain values are coerced to built-in ``float`` so
+# ``json.dumps(to_spec(...))`` never sees a numpy scalar).
+
+_PARAM_FIELDS = {
+    Ellipse: ("cx", "cy", "rx", "ry"),
+    Circle: ("cx", "cy", "r"),
+    HalfPlane: ("nx", "ny", "offset"),
+    Rectangle: ("x0", "y0", "x1", "y1"),
+    Translate: ("dx", "dy"),
+}
+
+
+def _as_param(v):
+    """Coerce concrete numbers to built-in float (JSON-serialisable via
+    ``to_spec``); traced/abstract values pass through untouched so the
+    same rebuild path serves ``jax.grad``."""
+    if isinstance(v, (bool,)):
+        raise _malformed(f"parameter must be a number, got {v!r}")
+    if isinstance(v, (int, float)):
+        return float(v)
+    import numpy as _np
+
+    if isinstance(v, _np.generic) or (
+        isinstance(v, _np.ndarray) and v.ndim == 0
+    ):
+        return float(v)
+    return v
+
+
+def n_params(shape) -> int:
+    """Number of numeric leaves ``params_of``/``with_params`` traverse."""
+    cls = type(shape)
+    if cls in (Union, Intersection):
+        return sum(n_params(s) for s in shape.shapes)
+    if cls is Difference:
+        return n_params(shape.a) + n_params(shape.b)
+    count = len(_PARAM_FIELDS.get(cls, ()))
+    if cls is Translate:
+        count += n_params(shape.shape)
+    if cls not in _PARAM_FIELDS and cls not in (Union, Intersection,
+                                                Difference):
+        raise _malformed(f"unknown shape node {cls.__name__!r}")
+    return count
+
+
+def params_of(shape):
+    """The shape tree's numeric leaves as a float64 numpy vector, in
+    ``to_spec`` order — the optimisation variable of the shape-
+    optimisation workload (``diff/``)."""
+    import numpy as _np
+
+    out: list[float] = []
+
+    def walk(s):
+        cls = type(s)
+        if cls in (Union, Intersection):
+            for child in s.shapes:
+                walk(child)
+            return
+        if cls is Difference:
+            walk(s.a)
+            walk(s.b)
+            return
+        fields = _PARAM_FIELDS.get(cls)
+        if fields is None:
+            raise _malformed(f"unknown shape node {cls.__name__!r}")
+        for f in fields:
+            out.append(float(getattr(s, f)))
+        if cls is Translate:
+            walk(s.shape)
+
+    walk(shape)
+    return _np.asarray(out, dtype=_np.float64)
+
+
+def with_params(shape, values):
+    """Rebuild ``shape``'s tree with its numeric leaves replaced by
+    ``values`` (any sequence/array of length ``n_params(shape)``).
+
+    The round trip ``with_params(s, params_of(s))`` reproduces ``s``
+    exactly (``to_spec`` byte-equal after ``json`` round-trip — fuzzed
+    in ``geom.fuzz``); traced ``values`` produce a shape whose level
+    set is differentiable w.r.t. them, which is how ``diff.assembly``
+    makes the θ→(a, b, rhs) path traceable end-to-end."""
+    values = list(values)
+    if len(values) != n_params(shape):
+        raise _malformed(
+            f"expected {n_params(shape)} parameters for this tree, got "
+            f"{len(values)}"
+        )
+    it = iter(values)
+
+    def rebuild(s):
+        cls = type(s)
+        if cls in (Union, Intersection):
+            return cls(*[rebuild(child) for child in s.shapes])
+        if cls is Difference:
+            return Difference(a=rebuild(s.a), b=rebuild(s.b))
+        fields = _PARAM_FIELDS[cls]
+        kwargs = {f: _as_param(next(it)) for f in fields}
+        if cls is Translate:
+            return Translate(shape=rebuild(s.shape), **kwargs)
+        return cls(**kwargs)
+
+    return rebuild(shape)
+
+
 def to_spec(shape) -> dict:
     """The JSON tree of ``shape`` (the serving/journal wire form)."""
     return shape.to_spec()

@@ -59,6 +59,13 @@ The serving surface:
   fleet --replicas 3 --requests 24 --kill-replica-at 8``. SIGTERM
   drains ``serve``/``fleet`` gracefully: stop admitting, finish
   in-flight, flush the trace, exit 0.
+- ``grad`` is the differentiable-solving drill (``diff/``): an
+  end-to-end inverse workload — ``--workload ellipse`` recovers
+  perturbed ellipse parameters from the solution they produced,
+  ``--workload source`` a per-node source field — driven by
+  implicit-function-theorem adjoints (one extra PCG per gradient) —
+  ``python -m poisson_ellipse_tpu.harness grad --workload ellipse
+  --engine mg-pcg``. Exit 0 iff the workload's acceptance holds.
 
 And the resilience surface:
 
@@ -879,6 +886,91 @@ def _run_serve(argv: list[str]) -> int:
             obs_trace.stop()
 
 
+def _run_grad(argv: list[str]) -> int:
+    """The ``grad`` subcommand: the differentiable-solving workloads
+    (``diff.optimize``) end-to-end — ellipse-recovers-itself inverse
+    geometry or inverse-source recovery, driven by IFT adjoints through
+    the converged solve (``diff.adjoint``)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness grad",
+        description="Differentiable solving (diff/): gradients of a "
+        "functional of the converged solution via implicit-function-"
+        "theorem adjoints — one extra PCG solve with the same operator "
+        "per gradient. Workloads: 'ellipse' recovers randomly perturbed "
+        "ellipse parameters from the solution they produced (acceptance "
+        "rel err <= 1e-3); 'source' recovers a per-node source field "
+        "(acceptance misfit drop >= 100x). Exit 0 on acceptance, 2 "
+        "otherwise.",
+    )
+    ap.add_argument("--workload", choices=("ellipse", "source"),
+                    default="ellipse")
+    ap.add_argument("--grid", default=None, metavar="MxN",
+                    help="grid (default 24x24 ellipse / 16x16 source)")
+    ap.add_argument("--engine", choices=("xla", "pipelined", "mg-pcg",
+                                         "cheb-pcg"), default="xla")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="optimizer step cap (workload defaults)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from poisson_ellipse_tpu.diff import optimize as diff_optimize
+
+    # the diff/ contract is f64 (gradient accuracy is quoted against
+    # the solve tolerance) — flip x64 like the menu's f64 entry does
+    # (harness.run.resolve_dtype): a process-global flag, set before
+    # any trace is built
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+    if args.trace:
+        obs_trace.start(args.trace)
+    try:
+        kwargs = {"engine": args.engine, "seed": args.seed}
+        if args.grid is not None:
+            try:
+                kwargs["grid"] = _parse_grid(args.grid)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        if args.steps is not None:
+            kwargs["steps"] = args.steps
+        if args.workload == "ellipse":
+            report = diff_optimize.recover_ellipse(**kwargs)
+        else:
+            report = diff_optimize.recover_source(**kwargs)
+        if args.json:
+            print(json.dumps(report))
+        elif args.workload == "ellipse":
+            print(
+                f"grad/{report['workload']}: grid "
+                f"{report['grid'][0]}x{report['grid'][1]} engine "
+                f"{report['engine']} — rel err {report['rel_err']:.2e} "
+                f"(acceptance 1e-3), misfit "
+                f"{report['misfit_initial']:.3e} -> "
+                f"{report['misfit_final']:.3e}, "
+                f"{report['n_evals']} value+grad evals — "
+                f"{'OK' if report['ok'] else 'NOT CONVERGED'}"
+            )
+        else:
+            print(
+                f"grad/{report['workload']}: grid "
+                f"{report['grid'][0]}x{report['grid'][1]} engine "
+                f"{report['engine']} — misfit drop "
+                f"{report['misfit_drop']:.1f}x (acceptance 100x), "
+                f"{report['n_evals']} value+grad evals — "
+                f"{'OK' if report['ok'] else 'NOT CONVERGED'}"
+            )
+        return 0 if report["ok"] else 2
+    finally:
+        obs_metrics.REGISTRY.emit()
+        obs_metrics.REGISTRY.reset()
+        if args.trace:
+            obs_trace.stop()
+
+
 def _run_chaos(argv: list[str]) -> int:
     """The ``chaos`` subcommand: the serving invariants under injected
     lane NaN, fake OOM and a kill/restart — zero lost, zero
@@ -1176,6 +1268,8 @@ def main(argv=None) -> int:
         return _run_fleet(argv[1:])
     if argv and argv[0] == "chaos":
         return _run_chaos(argv[1:])
+    if argv and argv[0] == "grad":
+        return _run_grad(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.harness",
         description="Fictitious-domain Poisson PCG on TPU",

@@ -160,6 +160,12 @@ def load_config(root: Optional[str] = None) -> LintConfig:
         retry_backoff_fns=tuple(
             table.get("retry-backoff-fns", cfg.retry_backoff_fns)
         ),
+        loop_solver_fns=tuple(
+            table.get("loop-solver-fns", cfg.loop_solver_fns)
+        ),
+        implicit_solver_fns=tuple(
+            table.get("implicit-solver-fns", cfg.implicit_solver_fns)
+        ),
     )
 
 

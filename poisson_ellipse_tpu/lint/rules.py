@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU016.
+"""The tpulint rule registry: TPU001–TPU017.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -64,6 +64,14 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | or never; deadline arithmetic must read       |
 |        |                    | `time.monotonic()` (timestamps that are only  |
 |        |                    | recorded, never compared, stay silent)        |
+| TPU017 | backprop-through-  | `jax.grad`/`jax.vjp` applied to a function    |
+|        | loop               | that binds a `lax.while_loop`-based solver    |
+|        |                    | entry without going through the implicit      |
+|        |                    | (`custom_vjp`) wrapper — while_loop has no    |
+|        |                    | reverse rule (trace error), and an unrolled   |
+|        |                    | workaround stores thousands of iterates; the  |
+|        |                    | IFT adjoint (`diff.adjoint.solve_implicit`)   |
+|        |                    | is one extra solve with the same operator     |
 """
 
 from __future__ import annotations
@@ -127,6 +135,24 @@ class LintConfig:
     # is the hot-spin retry storm the rule exists to fence.
     retry_backoff_fns: tuple[str, ...] = (
         "*sleep*", "*backoff*", "idle", "*.idle", "wait", "*.wait",
+    )
+    # TPU017: `lax.while_loop`-based solver entries (leaf-name/qualname
+    # fnmatch patterns). Applying reverse-mode autodiff to a function
+    # that binds one of these — without going through the implicit
+    # (custom_vjp) wrapper — either trace-errors (while_loop has no
+    # reverse rule) or, via a naive unroll, backpropagates through
+    # thousands of iterations.
+    loop_solver_fns: tuple[str, ...] = (
+        "pcg", "pcg_pipelined", "pcg_batched", "pcg_batched_pipelined",
+        "guarded_solve", "solve_batched", "solve_sharded", "elastic_solve",
+    )
+    # TPU017: the implicit-differentiation wrappers whose presence in
+    # the same target means the gradient is routed correctly (the IFT
+    # adjoint of ``diff.adjoint``, one extra solve — not a backprop
+    # through the loop).
+    implicit_solver_fns: tuple[str, ...] = (
+        "solve_implicit", "solve_operands", "*ImplicitSolver*",
+        "custom_linear_solve",
     )
 
 
@@ -2230,3 +2256,136 @@ def check_wall_clock_deadline(module: Module, config: LintConfig) -> Iterator[Fi
                 "`time.monotonic()`; keep wall-clock reads for "
                 "record-only timestamps",
             ))
+
+
+# --------------------------------------------------------------------------
+# TPU017 — reverse-mode autodiff over a while_loop-based solver entry
+# --------------------------------------------------------------------------
+
+# the reverse-mode entries: these stage a backward pass over their
+# target. jax.jvp/jacfwd are forward-mode (while_loop supports them)
+# and stay out of scope.
+_REVERSE_AD_ENTRIES = frozenset({
+    "jax.grad", "jax.value_and_grad", "jax.vjp", "jax.jacrev",
+    "jax.hessian",
+})
+
+
+def _matches_fn(module: Module, node: ast.AST,
+                patterns: tuple[str, ...]) -> bool:
+    """Does a callee expression match any pattern — by resolved
+    qualname or by leaf name (``solver.pcg`` matches ``pcg``)?"""
+    q = module.qualname(node) or ""
+    leaf = ""
+    if isinstance(node, ast.Name):
+        leaf = node.id
+    elif isinstance(node, ast.Attribute):
+        leaf = node.attr
+    return any(
+        fnmatch.fnmatch(q, pat) or fnmatch.fnmatch(leaf, pat)
+        for pat in patterns
+    )
+
+
+def _resolve_grad_target(module: Module, target: ast.AST):
+    """What reverse-mode will differentiate through, when statically
+    visible: ``("direct", node)`` for a bare callee reference (an
+    imported/attribute solver name — checked against the patterns by
+    name), ``("body", ast)`` for a lambda or locally-defined function
+    (checked by walking the body), recursing through a
+    ``functools.partial``'s first argument either way. None when the
+    target is opaque (a computed expression) — the registry's
+    conservative stance."""
+    if isinstance(target, ast.Lambda):
+        return ("body", target.body)
+    if isinstance(target, ast.Name):
+        fn = module.functions.get(target.id)
+        if fn is not None:
+            return ("body", fn)
+        return ("direct", target)
+    if isinstance(target, ast.Attribute):
+        return ("direct", target)
+    if isinstance(target, ast.Call) and target.args:
+        q = module.qualname(target.func) or ""
+        if q in ("functools.partial", "partial"):
+            return _resolve_grad_target(module, target.args[0])
+    return None
+
+
+@rule(
+    "TPU017",
+    "backprop-through-loop",
+    "reverse-mode autodiff (jax.grad/jax.vjp/...) applied to a "
+    "while_loop-based solver entry without the implicit custom_vjp "
+    "wrapper — no reverse rule for while_loop, and an unroll "
+    "backpropagates through thousands of iterations",
+)
+def check_backprop_through_loop(module: Module,
+                                config: LintConfig) -> Iterator[Finding]:
+    """The differentiable-solving fence. Every solver entry in this
+    repo binds its iteration as a fused ``lax.while_loop`` — which has
+    NO reverse-mode rule: ``jax.grad`` over one either raises at trace
+    time (dynamic trip count) or, rewritten to a scanned/unrolled loop,
+    stores every iterate of a thousand-iteration solve. The correct
+    route is the implicit-function-theorem wrapper
+    (``diff.adjoint.solve_implicit`` / ``ImplicitSolver``): one extra
+    PCG solve with the same operator.
+
+    Conservative per the registry's standing rules: a finding needs a
+    reverse-mode entry (``jax.grad``/``value_and_grad``/``vjp``/
+    ``jacrev``/``hessian``) whose target is statically visible (a
+    lambda, a local def, a direct solver-entry reference, or a
+    ``functools.partial`` of one) and binds a configured
+    ``loop-solver-fns`` callee; a target that also touches one of the
+    ``implicit-solver-fns`` is routing through the wrapper and stays
+    silent. Opaque targets are skipped, not guessed at.
+    """
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        q = module.qualname(node.func) or ""
+        if q not in _REVERSE_AD_ENTRIES:
+            continue
+        resolved = _resolve_grad_target(module, node.args[0])
+        if resolved is None:
+            continue
+        kind, payload = resolved
+        if kind == "direct":
+            # bare callee reference, possibly through a partial:
+            # jax.grad(pcg) / jax.vjp(functools.partial(pcg, problem))
+            if not _matches_fn(module, payload, config.loop_solver_fns):
+                continue
+            solver_name = (
+                payload.id if isinstance(payload, ast.Name)
+                else payload.attr
+            )
+        else:
+            hits = []
+            routed = False
+            for sub in ast.walk(payload):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _matches_fn(module, sub.func, config.implicit_solver_fns):
+                    routed = True
+                    break
+                if _matches_fn(module, sub.func, config.loop_solver_fns):
+                    hits.append(sub)
+            if routed or not hits:
+                continue
+            first = hits[0].func
+            solver_name = (
+                first.id if isinstance(first, ast.Name)
+                else getattr(first, "attr", "<solver>")
+            )
+        entry = q.rsplit(".", 1)[1]
+        yield _finding(
+            module,
+            node,
+            "TPU017",
+            f"`jax.{entry}` over `{solver_name}` backpropagates through "
+            "a `lax.while_loop` solver iteration — no reverse rule "
+            "(trace error) or an unbounded-memory unroll. Differentiate "
+            "through the IFT wrapper instead "
+            "(`diff.adjoint.solve_implicit` / `ImplicitSolver.solve`: "
+            "the adjoint is one extra solve with the same operator)",
+        )

@@ -60,10 +60,16 @@ class ChaosReport:
     adopted: int = 0
     stale_writes_rejected: int = 0
     zombie_drill: bool = False
+    # the grad-kind drill (differentiable serving): grad requests in
+    # the stream, and ids that completed WITHOUT a gradient — a grad
+    # completion missing its payload is a classification failure
+    grad_requests: int = 0
+    grad_missing_payload: list = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
-        return not (self.lost or self.double_completed or self.unclassified)
+        return not (self.lost or self.double_completed or self.unclassified
+                    or self.grad_missing_payload)
 
     def json_dict(self) -> dict:
         out = dataclasses.asdict(self)
@@ -92,6 +98,7 @@ def run_chaos(
     mesh_kill_request: Optional[int] = None,
     malformed_request: Optional[int] = None,
     degenerate_request: Optional[int] = None,
+    grad_requests: Sequence[int] = (),
     replicas: int = 1,
     replica_kill: Optional[int] = None,
     kill_during_handoff: bool = False,
@@ -116,6 +123,17 @@ def run_chaos(
     (``Scheduler._degrade_mesh``) — and the zero-lost/zero-double/
     all-classified invariants are asserted across a device kill, not
     just a process kill.
+
+    ``grad_requests`` names arrival indices that become ``grad=True``
+    requests (differentiable serving, ``diff.serving``): each runs two
+    consecutive lane solves (primal + IFT adjoint over the same
+    operator) and must terminally complete WITH its ``(value, grad)``
+    payload — a completed grad request missing the gradient fails the
+    report (``grad_missing_payload``). Kill/replay interleaves with the
+    two-stage lifecycle like any other request: the replayed recompute
+    is deterministic, so the invariants extend unchanged (the
+    mid-adjoint kill → identical-gradient pin lives in
+    ``tests/test_diff.py``, where the kill instant is surgical).
 
     ``malformed_request`` / ``degenerate_request`` arm the GEOMETRY
     drill: the named request's geometry spec is swapped at admission
@@ -154,6 +172,7 @@ def run_chaos(
             "mesh_kill_request": mesh_kill_request,
             "malformed_request": malformed_request,
             "degenerate_request": degenerate_request,
+            "grad_requests": tuple(grad_requests) or None,
         }
         armed = [k for k, v in dropped.items() if v is not None]
         if armed:
@@ -245,6 +264,7 @@ def run_chaos(
             replayed = sched.replay()
         time.sleep(min(rng.expovariate(rate_per_s), 0.01))
         M, N = rng.choice(list(grids))
+        is_grad = i in grad_requests
         req = ServeRequest(
             problem=Problem(M=M, N=N),
             deadline=(
@@ -252,6 +272,15 @@ def run_chaos(
                 else sched.clock() + deadline_s
             ),
             max_retries=max_retries,
+            # the grad kind rides the same stream: two lane solves
+            # (primal + IFT adjoint) ending in (value, grad) — the
+            # invariants extend to it unchanged, plus payload presence
+            grad=is_grad,
+            geometry=(
+                {"kind": "ellipse", "cx": 0.05, "cy": -0.02, "rx": 0.9,
+                 "ry": 0.45} if is_grad else None
+            ),
+            objective={"kind": "energy"} if is_grad else None,
         )
         req.request_id = _chaos_id(i)
         sched.submit_request(req)
@@ -272,6 +301,12 @@ def run_chaos(
     counts: dict[str, int] = {}
     for out in outcomes.values():
         counts[out] = counts.get(out, 0) + 1
+    grad_missing = [
+        _chaos_id(i) for i in grad_requests
+        if i < n_requests
+        and outcomes.get(_chaos_id(i)) == "completed"
+        and getattr(results[_chaos_id(i)], "grad", None) is None
+    ]
     report = ChaosReport(
         n_requests=n_requests,
         outcomes=outcomes,
@@ -286,6 +321,8 @@ def run_chaos(
         mesh_killed=any(
             f.kind == "device_loss" and f.fired for f in faults
         ),
+        grad_requests=sum(1 for i in grad_requests if i < n_requests),
+        grad_missing_payload=grad_missing,
     )
     obs_trace.event("serve:chaos-report", **report.json_dict())
     return report

@@ -91,6 +91,14 @@ class ServeRequest:
     # ADMISSION (never mid-solve) against ``geom.validate``
     geometry: Optional[dict] = None
     theta: Optional[float] = None
+    # the differentiable-solving kind (``diff.serving``): grad=True asks
+    # for (value, gradient) of ``objective`` (a ``diff.objectives`` JSON
+    # spec; None = Dirichlet energy) w.r.t. the geometry's parameters —
+    # served as two consecutive lane solves (primal, then the IFT
+    # adjoint with the same operator), terminally completing with
+    # ``ServeResult.value``/``ServeResult.grad``
+    grad: bool = False
+    objective: Optional[dict] = None
     # scheduler bookkeeping (not part of the wire spec)
     enqueued_t: Optional[float] = None
     admitted_t: Optional[float] = None
@@ -139,6 +147,8 @@ class ServeRequest:
             "max_retries": self.max_retries,
             "geometry": self.geometry,
             "theta": self.theta,
+            "grad": self.grad,
+            "objective": self.objective,
         }
 
     @classmethod
@@ -158,6 +168,8 @@ class ServeRequest:
             request_id=spec["request_id"],
             geometry=spec.get("geometry"),
             theta=spec.get("theta"),
+            grad=bool(spec.get("grad", False)),
+            objective=spec.get("objective"),
         )
 
 
@@ -185,6 +197,10 @@ class ServeResult:
     detail: Optional[str] = None
     retry_after_s: Optional[float] = None
     w: Optional[np.ndarray] = None
+    # grad-kind terminals (``grad=True`` requests): the objective value
+    # and the gradient w.r.t. the geometry's parameter vector
+    value: Optional[float] = None
+    grad: Optional[list] = None
 
     def __post_init__(self):
         if self.outcome not in OUTCOMES:

@@ -129,17 +129,15 @@ def _refill_scatter(a3, b3, mask, h1, h2, delta, state, unit,
     return a3, b3, mask, h1, h2, delta, state
 
 
-def _embed_request(problem: Problem, bucket: tuple[int, int], np_dtype,
-                   geometry=None, theta=None):
-    """Pad-and-mask one request into a bucket: zero-padded operands,
-    interior mask over the true problem (the ``runtime.compile_cache``
-    embedding, sliced per lane). ``geometry``/``theta`` select the SDF
-    quadrature assembly — a host-side operand fact, so an arbitrary
-    domain rides the SAME bucket executable (shapes are the only
-    compile keys)."""
+def embed_operands(problem: Problem, bucket: tuple[int, int], np_dtype,
+                   a, b, rhs):
+    """THE pad-and-mask bucket embedding: zero-padded operands plus the
+    interior mask of the true problem (the ``runtime.compile_cache``
+    layout, sliced per lane). One definition — ordinary requests
+    (``_embed_request``) and grad-kind stages (``diff.serving.GradJob.
+    embed``) must stay layout-identical by construction, not by
+    parallel maintenance."""
     Mb, Nb = bucket
-    a, b, r = assembly.assemble_numpy(problem, geometry=geometry,
-                                      theta=theta)
     g1, g2 = problem.M + 1, problem.N + 1
     pad2 = ((0, Mb + 1 - g1), (0, Nb + 1 - g2))
     mask = np.zeros((Mb + 1, Nb + 1), np_dtype)
@@ -147,9 +145,20 @@ def _embed_request(problem: Problem, bucket: tuple[int, int], np_dtype,
     return (
         np.pad(a, pad2).astype(np_dtype),
         np.pad(b, pad2).astype(np_dtype),
-        np.pad(r, pad2).astype(np_dtype),
+        np.pad(rhs, pad2).astype(np_dtype),
         mask,
     )
+
+
+def _embed_request(problem: Problem, bucket: tuple[int, int], np_dtype,
+                   geometry=None, theta=None):
+    """Pad-and-mask one request into a bucket via ``embed_operands``.
+    ``geometry``/``theta`` select the SDF quadrature assembly — a
+    host-side operand fact, so an arbitrary domain rides the SAME
+    bucket executable (shapes are the only compile keys)."""
+    a, b, r = assembly.assemble_numpy(problem, geometry=geometry,
+                                      theta=theta)
+    return embed_operands(problem, bucket, np_dtype, a, b, r)
 
 
 class _InFlight:
@@ -266,6 +275,11 @@ class Scheduler:
         self.queue = AdmissionQueue(queue_capacity, lanes, clock=clock)
         self.results: dict[str, ServeResult] = {}
         self._ctxs: dict[tuple, _BatchCtx] = {}
+        # grad-kind lifecycle state (diff.serving.GradJob) keyed by
+        # request id: host-only, NEVER journaled — a replayed grad
+        # request rebuilds its job deterministically, which is what
+        # makes the replayed gradient identical (chaos invariant)
+        self._grad_jobs: dict[str, object] = {}
         self._np_dtype = assembly.numpy_dtype(dtype)
         # journaled requests recovered by replay() that exceeded queue
         # capacity: fed back into the queue in waves as it drains —
@@ -356,6 +370,33 @@ class Scheduler:
             return result
         return None
 
+    def _validate_objective(self, req: ServeRequest) -> Optional[ServeResult]:
+        """The grad kind's admission rung: a malformed objective spec
+        ends terminally ``invalid`` at the door — same stance as the
+        geometry gate, so no lane ever hosts a request whose cotangent
+        evaluation would throw at a chunk boundary."""
+        if not req.grad:
+            return None
+        from poisson_ellipse_tpu.diff.objectives import objective_from_spec
+
+        try:
+            objective_from_spec(req.objective, req.problem)
+        except (ValueError, TypeError) as e:
+            # TypeError belt: the objectives layer classifies malformed
+            # payloads as ValueError, but an admission gate must never
+            # let a client payload crash the scheduler step
+            result = ServeResult(
+                request_id=req.request_id, outcome="invalid",
+                detail=f"objective: {e}",
+            )
+            self.results[req.request_id] = result
+            obs_trace.event(
+                "serve:invalid-objective", request_id=req.request_id,
+                reason=str(e),
+            )
+            return result
+        return None
+
     def begin_drain(self) -> None:
         """The graceful-shutdown hook: stop admitting, keep working.
 
@@ -428,6 +469,10 @@ class Scheduler:
             # compensate the admit: the request leaves the queue before
             # anything durable (journal) or dispatchable sees it
             self.queue.retract(req, "invalid-geometry")
+            return invalid
+        invalid = self._validate_objective(req)
+        if invalid is not None:
+            self.queue.retract(req, "invalid-objective")
             return invalid
         if self.journal is not None:
             # write-ahead: the admission is acknowledged only once the
@@ -641,10 +686,18 @@ class Scheduler:
         lane's trajectory is bit-identical to a fresh lane-0 solve of
         the same embedding (pinned in ``tests/test_batched.py``)."""
         p = req.problem
-        a_p, b_p, r_p, m_p = _embed_request(
-            p, ctx.bucket, self._np_dtype,
-            geometry=req.geometry_sdf(), theta=req.theta,
-        )
+        if req.grad:
+            # grad kind: the job's differentiably-assembled operands
+            # (primal stage) or the normalised cotangent RHS over the
+            # same operator (adjoint stage) — still just a lane
+            a_p, b_p, r_p, m_p = self._grad_job(req).embed(
+                ctx.bucket, self._np_dtype
+            )
+        else:
+            a_p, b_p, r_p, m_p = _embed_request(
+                p, ctx.bucket, self._np_dtype,
+                geometry=req.geometry_sdf(), theta=req.theta,
+            )
         # the lane's fresh carry comes from the same eager init_state
         # every other entry path uses (the bit-parity pin's reference);
         # the scatter into the batch is one fused dispatch
@@ -713,10 +766,13 @@ class Scheduler:
             req_iters = int(iters[lane]) - slot.base_k
             diff = float(diffs[lane])
             if conv[lane]:
-                self._finish(
-                    ctx, lane, slot, "completed", iters=req_iters,
-                    diff=diff, converged=True,
-                )
+                if req.grad:
+                    self._grad_boundary(ctx, lane, slot, req_iters, diff)
+                else:
+                    self._finish(
+                        ctx, lane, slot, "completed", iters=req_iters,
+                        diff=diff, converged=True,
+                    )
             elif quar[lane] or bd[lane]:
                 cause = "lane-quarantine" if quar[lane] else "breakdown"
                 self._park_lane(ctx, lane)
@@ -749,6 +805,82 @@ class Scheduler:
             for s in ctx.slots:
                 if s is not None:
                     s.base_k -= base
+
+    # -- the grad kind (diff.serving) ----------------------------------------
+
+    def _grad_job(self, req: ServeRequest):
+        """The request's GradJob, built on first dispatch (and rebuilt
+        deterministically after a replay — the job is host state, the
+        journal holds only the request spec)."""
+        job = self._grad_jobs.get(req.request_id)
+        if job is None:
+            from poisson_ellipse_tpu.diff.serving import GradJob
+
+            job = GradJob(req)
+            self._grad_jobs[req.request_id] = job
+        return job
+
+    def _grad_boundary(self, ctx: _BatchCtx, lane: int, slot: _InFlight,
+                       req_iters: int, diff: float) -> None:
+        """A grad request's lane converged: either stage the adjoint
+        (primal done — the cotangent becomes the next lane's RHS) or
+        terminally complete with (value, grad) (adjoint done)."""
+        req = slot.req
+        job = self._grad_job(req)
+        g1, g2 = req.problem.M + 1, req.problem.N + 1
+        u = np.asarray(ctx.state[_IDX["w"]][lane])[:g1, :g2].copy()
+        if job.stage == "primal":
+            pending = job.absorb_primal(u, req_iters)
+            self._park_lane(ctx, lane)
+            if pending:
+                obs_trace.event(
+                    "diff:adjoint-dispatch", request_id=req.request_id,
+                    lane=lane, primal_iters=req_iters,
+                    value=job.value,
+                )
+                # the adjoint is an ordinary queued dispatch: it lands
+                # on whatever lane frees next (retire-and-refill), and
+                # deadline expiry still applies while it waits. Re-entry
+                # goes through the replay-backlog waves, NOT push_front:
+                # the request holds no queue slot right now, so a full
+                # queue's maxlen backstop would silently evict someone
+                # else's admission — the backlog is the never-shed lane
+                # for work the scheduler already owns
+                self._replay_backlog.append(req)
+                self._admit_replay_wave()
+            else:
+                # zero cotangent — the gradient is exactly zero; no
+                # second solve to pay for
+                self._grad_finish(req, slot, job, job.zero_grad(),
+                                  iters=req_iters, diff=diff, lane=lane)
+            return
+        grad = job.finish(u, req_iters)
+        self._park_lane(ctx, lane)
+        self._grad_finish(req, slot, job, grad,
+                          iters=job.primal_iters + req_iters, diff=diff,
+                          lane=lane)
+
+    def _grad_finish(self, req: ServeRequest, slot: _InFlight, job,
+                     grad, iters: int, diff: float, lane: int) -> None:
+        now = self.clock()
+        self.queue.observe_service(now - slot.t_dispatch)
+        result = ServeResult(
+            request_id=req.request_id, outcome="completed", iters=iters,
+            diff=diff, converged=True, dispatched=True,
+            attempts=req.attempt + 1,
+            time_in_queue_s=(
+                slot.t_dispatch - req.enqueued_t
+                if req.enqueued_t is not None else 0.0
+            ),
+            total_s=self._span_s(req, now),
+            detail="grad",
+            w=(np.asarray(job.u).copy()
+               if self.keep_solutions and job.u is not None else None),
+            value=job.value,
+            grad=np.asarray(grad, np.float64).tolist(),
+        )
+        obs_metrics.counter("grad_completed_total").inc()
+        self._record_terminal(result, lane=lane)
 
     @staticmethod
     def _span_s(req: ServeRequest, now: float) -> float:
@@ -817,6 +949,9 @@ class Scheduler:
                 result.request_id, result.outcome, detail=result.detail
             )
         self.results[result.request_id] = result
+        # a terminal grad request's host lifecycle state goes with it
+        # (deadline-miss/cap/failed included — replay rebuilds)
+        self._grad_jobs.pop(result.request_id, None)
         if result.outcome == "deadline-miss":
             obs_metrics.counter("deadline_miss_total").inc()
         elif result.outcome == "completed":
@@ -838,6 +973,13 @@ class Scheduler:
         takes over. Every rung ends in a classified outcome."""
         req = slot.req
         req.attempt += 1
+        if req.grad:
+            # the lane's carry is gone; a grad request restarts its
+            # two-stage lifecycle from the primal (deterministic, so
+            # the eventual gradient is unchanged)
+            job = self._grad_jobs.get(req.request_id)
+            if job is not None:
+                job.reset()
         if req.attempt <= req.max_retries:
             backoff = self.backoff_base_s * (2 ** (req.attempt - 1))
             req.not_before = self.clock() + backoff
@@ -875,6 +1017,42 @@ class Scheduler:
             "serve:fallback", request_id=req.request_id, cause=cause,
             attempt=req.attempt,
         )
+        if req.grad:
+            # the grad kind's last rung: the un-laned implicit solve
+            # (diff.serving.solve_grad_direct) — deterministic, so the
+            # fallback quotes the same (value, grad) a lane pair would
+            from poisson_ellipse_tpu.diff.serving import solve_grad_direct
+
+            try:
+                value, grad, iters = solve_grad_direct(req)
+            except Exception:  # tpulint: disable=TPU009 — classified below
+                self._finish_queued(
+                    req, "failed", detail=f"grad-fallback-error ({cause})"
+                )
+                return
+            now = self.clock()
+            if req.deadline is not None and now > req.deadline:
+                # the implicit solve is not chunk-cancellable (yet), so
+                # the deadline is enforced at its granularity: a late
+                # gradient is classified, never delivered as completed
+                self._finish_queued(
+                    req, "deadline-miss",
+                    detail=f"grad-fallback-exceeded-deadline ({cause})",
+                )
+                return
+            self._record_terminal(ServeResult(
+                request_id=req.request_id, outcome="completed",
+                iters=iters, diff=0.0, converged=True, dispatched=True,
+                attempts=req.attempt + 1,
+                time_in_queue_s=(
+                    t_dispatch - req.enqueued_t
+                    if req.enqueued_t is not None else 0.0
+                ),
+                total_s=self._span_s(req, now),
+                detail="grad-guarded-fallback",
+                value=value, grad=np.asarray(grad).tolist(),
+            ))
+            return
         try:
             guarded = guarded_solve(
                 req.problem, "xla", self.dtype, chunk=self.chunk,

@@ -415,3 +415,50 @@ def test_geometry_only_in_one_round_is_noted_not_failed():
     regs, notes = bc.compare(old, new, TOL)
     assert not regs
     assert any("geometry" in n for n in notes)
+
+
+def _grad_row(**overrides) -> dict:
+    row = {
+        "grid": [400, 600], "lanes": 4, "n_requests": 8,
+        "grad_solves_per_sec": 10.0, "wall_s": 0.8,
+        "rows": [{"grid": [400, 600], "primal_iters": 546,
+                  "adjoint_iters": 540, "ratio": 0.989}],
+        "valid": True,
+    }
+    row.update(overrides)
+    return row
+
+
+def test_grad_throughput_drop_is_a_regression():
+    old = make_round(grad=_grad_row())
+    new = make_round(grad=_grad_row(
+        grad_solves_per_sec=10.0 * (1 - TOL["grad-pct"]) * 0.99
+    ))
+    assert regressions_between(old, new) == [
+        ("grad_solves_per_sec", "grad")
+    ]
+    new = make_round(grad=_grad_row(
+        grad_solves_per_sec=10.0 * (1 - TOL["grad-pct"]) * 1.01
+    ))
+    assert regressions_between(old, new) == []
+
+
+def test_grad_adjoint_ratio_growth_is_a_regression():
+    old = make_round(grad=_grad_row())
+    grown = [{"grid": [400, 600], "primal_iters": 546,
+              "adjoint_iters": 1100, "ratio": 2.015}]
+    new = make_round(grad=_grad_row(rows=grown))
+    assert regressions_between(old, new) == [
+        ("grad_adjoint_ratio", "grad 400x600")
+    ]
+    near = [{"grid": [400, 600], "primal_iters": 546,
+             "adjoint_iters": 560, "ratio": 1.026}]
+    assert regressions_between(old, make_round(grad=_grad_row(rows=near))) == []
+
+
+def test_grad_only_in_one_round_is_noted_not_failed():
+    old = make_round()
+    new = make_round(grad=_grad_row())
+    regs, notes = bc.compare(old, new, TOL)
+    assert not regs
+    assert any("grad" in n for n in notes)

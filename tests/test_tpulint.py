@@ -27,10 +27,10 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_sixteen_rules():
+def test_registry_has_all_seventeen_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
         "TPU010", "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
-        "TPU016",
+        "TPU016", "TPU017",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -1751,3 +1751,124 @@ def test_cli_exits_nonzero_on_fixture(tmp_path):
     )
     assert proc.returncode == 1
     assert "TPU001" in proc.stdout
+
+
+# -- TPU017: reverse-mode autodiff over a while_loop solver entry -----------
+
+
+def test_tpu017_positive_lambda_local_def_and_direct_reference():
+    src = """
+        import jax
+        from poisson_ellipse_tpu.solver.pcg import pcg
+
+        def bad_lambda(problem, a, b, rhs):
+            return jax.grad(lambda p: pcg(problem, a * p, b, rhs).diff)(1.0)
+
+        def bad_local_def(problem, a, b, rhs):
+            def loss(p):
+                return pcg_pipelined(problem, a * p, b, rhs).diff
+            return jax.value_and_grad(loss)(2.0)
+
+        g = jax.vjp(guarded_solve, 3.0)
+    """
+    assert codes_of(src) == ["TPU017", "TPU017", "TPU017"]
+
+
+def test_tpu017_positive_partial_and_attribute_callee():
+    src = """
+        import functools
+        import jax
+
+        def bad_partial(solver, x):
+            return jax.jacrev(functools.partial(
+                lambda q: solver.pcg_batched(q).diff
+            ))(x)
+    """
+    assert codes_of(src) == ["TPU017"]
+
+
+def test_tpu017_positive_partial_of_direct_reference():
+    # the documented hazard spelled exactly: a partial over an IMPORTED
+    # solver entry (no local def to walk — the name itself must match)
+    src = """
+        import functools
+        import jax
+        from poisson_ellipse_tpu.solver.pcg import pcg
+
+        def bad(problem, a, b, x):
+            return jax.grad(functools.partial(pcg, problem, a, b))(x)
+
+        g = jax.vjp(functools.partial(guarded_solve, 1), 2.0)
+    """
+    assert codes_of(src) == ["TPU017", "TPU017"]
+
+
+def test_tpu017_negative_partial_of_benign_reference():
+    src = """
+        import functools
+        import jax
+
+        def ok(fn, x):
+            return jax.grad(functools.partial(my_smooth_fn, 1))(x)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu017_negative_implicit_wrapper_and_forward_mode():
+    # routing through the implicit wrapper, forward-mode entries, and
+    # opaque targets all stay silent — the conservative stance
+    src = """
+        import jax
+        from poisson_ellipse_tpu.diff.adjoint import solve_implicit
+
+        def good_wrapper(problem, params):
+            def loss(p):
+                u = solve_implicit(problem, p)
+                return (u * u).sum()
+            return jax.grad(loss)(params)
+
+        def good_solver_obj(solver, params):
+            return jax.grad(
+                lambda p: solver.solve_operands(p, p, p).sum()
+            )(params)
+
+        def good_opaque(fn, x):
+            return jax.grad(fn)(x)
+
+        def good_forward(x):
+            return jax.jvp(pcg, (x,), (1.0,))
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu017_config_knobs():
+    # a project's own loop-solver name fires only when configured, and
+    # a custom implicit wrapper name silences when configured
+    src = """
+        import jax
+        g = jax.grad(lambda x: my_loop_solve(x).w)(1.0)
+    """
+    assert codes_of(src) == []
+    assert codes_of(src, loop_solver_fns=("my_loop_solve",)) == ["TPU017"]
+    routed = """
+        import jax
+        def f(x):
+            def loss(p):
+                my_wrapper(p)
+                return my_loop_solve(p).w
+            return jax.grad(loss)(x)
+    """
+    assert codes_of(routed, loop_solver_fns=("my_loop_solve",)) == ["TPU017"]
+    assert codes_of(
+        routed,
+        loop_solver_fns=("my_loop_solve",),
+        implicit_solver_fns=("my_wrapper",),
+    ) == []
+
+
+def test_tpu017_suppression_comment():
+    src = """
+        import jax
+        g = jax.grad(lambda x: pcg(x).w)(1.0)  # tpulint: disable=TPU017
+    """
+    assert codes_of(src) == []

@@ -496,3 +496,44 @@ def test_geometry_field_absent_or_failed_is_supported(workspace):
     text = readme.read_text()
     assert "Geometry (SDF quadrature" in text
     assert "Composite domain" not in text
+
+
+def test_grad_field_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        grad={
+            "grid": [400, 600], "lanes": 4, "n_requests": 8,
+            "grad_solves_per_sec": 12.5, "wall_s": 0.64,
+            "rows": [{"grid": [400, 600], "primal_iters": 546,
+                      "adjoint_iters": 540, "ratio": 0.989}],
+            "valid": True,
+        }
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Differentiable solving" in text
+    assert "12.5 grad-solves/sec" in text
+    assert "540/546" in text
+    assert "grad-pct" in text
+
+
+def test_grad_field_absent_or_failed_is_supported(workspace):
+    # pre-diff artifacts lack the key; a failed throughput half (no
+    # grad_solves_per_sec) still renders the ratio rows it carries
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    assert "Differentiable solving" not in readme.read_text()
+    artifact.write_text(json.dumps(make_artifact(
+        grad={
+            "grid": [400, 600], "grad_solves_per_sec": None,
+            "rows": [{"grid": [400, 600], "primal_iters": 546,
+                      "adjoint_iters": 560, "ratio": 1.026}],
+            "valid": False,
+        }
+    )))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Differentiable solving" not in text
+    assert "Adjoint-vs-primal iterations" in text
+    assert "560/546" in text

@@ -33,6 +33,10 @@ older artifacts predate newer keys, which must never fail the gate):
 - `fleet` rows (keyed by replica count): aggregate `solves_per_sec`
   through the replicated fleet dropping more than `fleet-agg-pct`, and
   the `non_decreasing` scaling pin breaking in the new round
+- the `grad` row: grad-solves/sec through the scheduler dropping more
+  than `grad-pct`, and the per-grid adjoint/primal iteration ratio
+  growing past the same band (the adjoint must stay "one extra solve
+  with the same operator", not drift into its own convergence story)
 
 Tolerances live in `pyproject.toml [tool.bench_compare]` (shared by the
 CLI and the driver-dryrun smoke gate); built-in defaults apply when the
@@ -75,6 +79,10 @@ DEFAULT_TOLERANCES = {
     # fleet aggregate solves/sec per replica count: the replicated
     # serving layer's throughput shares the serving noise floor
     "fleet-agg-pct": 0.25,
+    # grad key: grad-solves/sec through the scheduler shares the
+    # serving noise floor; the adjoint/primal iteration ratio gets the
+    # same band (same-operator adjoints must keep tracking the primal)
+    "grad-pct": 0.25,
 }
 
 # scalar-row artifact keys carrying {grid, t_solver_s, iters}
@@ -423,6 +431,41 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
             ))
     elif (o_geo is None) != (n_geo is None):
         notes.append("geometry: only in one round, skipped")
+
+    # the grad key: grad-solves/sec through the scheduler (the served
+    # differentiable-solving throughput) under `grad-pct`, plus the
+    # per-grid adjoint/primal iteration ratio as a hard pin — the
+    # adjoint reuses the same operator and preconditioner, so its
+    # iteration count drifting far past the primal's means the adjoint
+    # path stopped being "one extra solve"
+    o_grad, n_grad = old.get("grad"), new.get("grad")
+    if isinstance(o_grad, dict) and isinstance(n_grad, dict):
+        o_g = o_grad.get("grad_solves_per_sec")
+        n_g = n_grad.get("grad_solves_per_sec")
+        if not one_sided("grad grad_solves_per_sec", "grad", o_g, n_g) \
+                and o_g and n_g is not None:
+            limit = tol["grad-pct"]
+            if n_g < o_g * (1.0 - limit):
+                regressions.append(Regression(
+                    "grad_solves_per_sec", "grad", o_g, n_g,
+                    f"{(n_g / o_g - 1):.0%} > {limit:.0%} drop",
+                ))
+        o_rows = {tuple(r["grid"]): r for r in o_grad.get("rows") or []}
+        n_rows = {tuple(r["grid"]): r for r in n_grad.get("rows") or []}
+        for key in sorted(o_rows.keys() & n_rows.keys()):
+            o_r, n_r = o_rows[key].get("ratio"), n_rows[key].get("ratio")
+            if o_r is None or n_r is None:
+                continue
+            where_grad = f"grad {_grid_label(key)}"
+            limit = tol["grad-pct"]
+            if n_r > max(o_r * (1.0 + limit), o_r + 0.1):
+                regressions.append(Regression(
+                    "grad_adjoint_ratio", where_grad, o_r, n_r,
+                    f"adjoint/primal ratio +{(n_r / o_r - 1):.0%} > "
+                    f"+{limit:.0%}",
+                ))
+    elif (o_grad is None) != (n_grad is None):
+        notes.append("grad: only in one round, skipped")
 
     return regressions, notes
 

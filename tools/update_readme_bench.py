@@ -210,7 +210,50 @@ def table_block(rec: dict, src: str) -> str:
     geometry = geometry_lines(rec)
     if geometry:
         lines += [""] + geometry
+    grad = grad_lines(rec)
+    if grad:
+        lines += [""] + grad
     return "\n".join(lines)
+
+
+def grad_lines(rec: dict) -> list[str]:
+    """Prose for the artifact's ``grad`` key (differentiable serving,
+    emitted since diff/ landed): grad-solves/sec through the scheduler
+    plus the adjoint-vs-primal iteration ratio per grid. Pre-diff
+    artifacts lack the key and render without the lines; a failed run
+    (no grad_solves_per_sec) still renders any iteration-ratio rows it
+    carries — absence and partial are supported inputs, not errors."""
+    grad = rec.get("grad")
+    if not isinstance(grad, dict):
+        return []
+    lines = []
+    gps = grad.get("grad_solves_per_sec")
+    if gps is not None and grad.get("grid"):
+        g = grad["grid"]
+        lines.append(
+            f"Differentiable solving (`diff/`, IFT adjoints through the "
+            f"converged solve): {gps:g} grad-solves/sec at "
+            f"{g[0]}×{g[1]} through the scheduler "
+            f"({grad.get('lanes', '?')} candidate lanes, each gradient "
+            f"= primal + adjoint lane solve; regression-gated by "
+            f"`tools/bench_compare.py` `grad-pct`)."
+        )
+    rows = [
+        r for r in (grad.get("rows") or [])
+        if r.get("ratio") is not None and r.get("grid")
+    ]
+    if rows:
+        ratios = ", ".join(
+            f"{r['grid'][0]}×{r['grid'][1]} "
+            f"{r['adjoint_iters']}/{r['primal_iters']} "
+            f"({r['ratio']:g})"
+            for r in rows
+        )
+        lines.append(
+            f"Adjoint-vs-primal iterations (same operator, same "
+            f"preconditioner): {ratios}."
+        )
+    return lines
 
 
 def fleet_lines(rec: dict) -> list[str]:
