@@ -926,6 +926,178 @@ def bench_abft(grid: tuple[int, int] = (800, 1200)):
     return row, ok
 
 
+# bandwidth key: the modeled bf16/f32 byte ratio every cell must beat
+# (acceptance: ≤ 0.6×), and the l2 parity band the guarded bf16 path
+# must land in relative to the f32 cell (the guard's promotion rung
+# finishes every narrow solve at full width, so parity is recovered,
+# not approximate — the band absorbs iterate-path noise only)
+BANDWIDTH_BYTE_RATIO_GATE = 0.6
+BANDWIDTH_L2_BAND = 1.10
+BANDWIDTH_GRID = (2400, 3200)
+
+
+def bench_bandwidth(grid: tuple[int, int] = BANDWIDTH_GRID):
+    """The memory-bandwidth-frontier key: {f32, bf16-storage} ×
+    {pipelined, sstep} at the HBM-bound grid.
+
+    Per cell: T_solver, achieved GB/s against the storage-width traffic
+    model (``harness.roofline``), and the analytic l2_err. The f32
+    cells run the raw engines fenced and warm (steady-state); the bf16
+    cells run the PRODUCT path — ``resilience.guard`` with the storage
+    promotion rung, because the raw narrow engines converge to the
+    storage floor by design — under the guard's documented plain-wall-
+    clock protocol (adapter builds included; ``protocol`` names this
+    per cell, and the round-over-round gate in bench_compare compares
+    like with like). A bf16 cell's GB/s apportions its bytes across
+    the narrow phase and the full-width polish using the promotion
+    iteration from the recovery log — never all-narrow for a run whose
+    tail ran full-width. Gates folded into ``valid``: every cell
+    converged, each bf16 cell's modeled HBM bytes/iter ≤ 0.6× its f32
+    sibling's, and bf16 l2_err within the parity band of f32's.
+    """
+    import jax.numpy as jnp
+
+    from poisson_ellipse_tpu.harness.roofline import (
+        modeled_hbm_bytes_per_iter,
+        roofline,
+    )
+    from poisson_ellipse_tpu.resilience.guard import guarded_solve
+    from poisson_ellipse_tpu.solver.engine import build_solver
+    from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
+
+    M, N = grid
+    problem = Problem(M=M, N=N)
+    cells = []
+    ok = True
+    try:
+        for engine in ("pipelined", "sstep"):
+            f32_l2 = None
+            for storage in (None, "bf16"):
+                if storage is None:
+                    solver, args, _ = build_solver(problem, engine)
+                    jax.block_until_ready(solver(*args))  # warm compile
+                    t0 = time.perf_counter()
+                    result = solver(*args)
+                    jax.block_until_ready(result)  # tpulint: disable=TPU011
+                    t = time.perf_counter() - t0
+                    iters = int(result.iters)
+                    converged = bool(result.converged)
+                    w = result.w
+                    narrow_iters = None
+                else:
+                    t0 = time.perf_counter()
+                    guarded = guarded_solve(
+                        problem, engine, jnp.float32, storage_dtype=storage
+                    )
+                    jax.block_until_ready(guarded.result.w)  # tpulint: disable=TPU011
+                    t = time.perf_counter() - t0
+                    iters = int(guarded.result.iters)
+                    converged = bool(guarded.result.converged)
+                    w = guarded.result.w.astype(jnp.float32)
+                    # iterations the NARROW phase ran: up to the
+                    # promotion event (whole run if it never fired)
+                    narrow_iters = iters
+                    for ev in guarded.recoveries:
+                        if ev.kind == "storage-promotion":
+                            narrow_iters = min(narrow_iters, ev.at_iter)
+                l2 = float(l2_error_vs_analytic(problem, w))
+                if storage is None or narrow_iters is None:
+                    roof = roofline(
+                        problem, engine, iters, t, jnp.float32,
+                        storage_dtype=storage,
+                    )
+                else:
+                    # apportion: narrow_iters at bf16 bytes + the
+                    # full-width polish at f32 bytes, over the one
+                    # measured wall clock
+                    from poisson_ellipse_tpu.harness.roofline import (
+                        hbm_peak_bytes_per_s,
+                        modeled_hbm_bytes_per_iter,
+                    )
+
+                    total_bytes = (
+                        narrow_iters * modeled_hbm_bytes_per_iter(
+                            problem, engine, jnp.float32,
+                            storage_dtype=storage,
+                        )
+                        + max(iters - narrow_iters, 0)
+                        * modeled_hbm_bytes_per_iter(
+                            problem, engine, jnp.float32
+                        )
+                    )
+                    gbps = total_bytes / t / 1e9 if t > 0 else 0.0
+                    peak = hbm_peak_bytes_per_s()
+                    roof = {
+                        "hbm_gbps": round(gbps, 2),
+                        "hbm_peak_frac": (
+                            round(total_bytes / t / peak, 4)
+                            if peak and t > 0 else None
+                        ),
+                    }
+                modeled = modeled_hbm_bytes_per_iter(
+                    problem, engine, jnp.float32, storage_dtype=storage
+                )
+                if storage is None:
+                    f32_l2 = l2
+                    byte_ratio, parity = None, True
+                else:
+                    f32_modeled = modeled_hbm_bytes_per_iter(
+                        problem, engine, jnp.float32
+                    )
+                    byte_ratio = modeled / f32_modeled
+                    parity = l2 <= BANDWIDTH_L2_BAND * f32_l2
+                    ok &= byte_ratio <= BANDWIDTH_BYTE_RATIO_GATE and parity
+                ok &= converged
+                cells.append({
+                    "engine": engine,
+                    "storage": storage or "f32",
+                    # f32 cells: fenced steady-state dispatch; bf16
+                    # cells: the guard's plain wall clock, builds
+                    # included (the documented resilience stance)
+                    "protocol": (
+                        "fenced-warm" if storage is None
+                        else "guarded-wall-clock"
+                    ),
+                    "t_solver_s": round(t, 5),
+                    "iters": iters,
+                    **(
+                        {"narrow_iters": narrow_iters}
+                        if narrow_iters is not None else {}
+                    ),
+                    "converged": converged,
+                    "l2_err": l2,
+                    "hbm_gbps": roof["hbm_gbps"],
+                    "hbm_peak_frac": roof["hbm_peak_frac"],
+                    "modeled_bytes_per_iter": modeled,
+                    **(
+                        {"byte_ratio_vs_f32": round(byte_ratio, 4),
+                         "l2_parity": parity}
+                        if byte_ratio is not None else {}
+                    ),
+                })
+                note(
+                    f"  [bandwidth] {engine}/{storage or 'f32'} {M}x{N}: "
+                    f"{t:.3f}s, {iters} iters, l2 {l2:.3e}, "
+                    f"{roof['hbm_gbps']:.0f} GB/s"
+                    + (
+                        f", bytes ratio {byte_ratio:.2f}x"
+                        if byte_ratio is not None else ""
+                    )
+                )
+    except Exception as e:  # noqa: BLE001 — the study must never kill
+        # the artifact: every other key's rows already ran and must ship
+        note(f"  [bandwidth] study failed ({type(e).__name__}: {e})")
+        return {"available": False, "error": str(e)}, True
+    return {
+        "available": True,
+        "grid": [M, N],
+        "byte_ratio_gate": BANDWIDTH_BYTE_RATIO_GATE,
+        "l2_band": BANDWIDTH_L2_BAND,
+        "cells": cells,
+        "ok": ok,
+    }, ok
+
+
 THROUGHPUT_LANES = (1, 8, 32)
 THROUGHPUT_GRIDS = ((400, 600, 546), (800, 1200, 989))
 
@@ -1340,6 +1512,10 @@ def main() -> int:
     # ABFT overhead study: silent-corruption checks on vs off — ≤2%
     # T_solver and identical collective counts (f32, pre-f64-flip)
     abft_row, oka = bench_abft()
+    # memory-bandwidth frontier: {f32, bf16-storage} × {pipelined,
+    # sstep} at the HBM-bound grid — GB/s, T_solver, l2 parity and the
+    # ≤0.6× modeled byte ratio (f32, pre-f64-flip)
+    bw_row, okbw = bench_bandwidth()
     # geometry study: SDF-quadrature-vs-closed-form parity + overhead
     # and the composite-domain timing row (f32, pre-f64-flip)
     geom_row, okg = bench_geometry()
@@ -1348,7 +1524,7 @@ def main() -> int:
     grad_row, okgr = bench_grad()
     all_ok &= (
         ok2 & okn & ok8 & okp & okpc & okt & okcs & oksv & okfl & oke
-        & okc & okl & oks & okr & oka & okg & okgr
+        & okc & okl & oks & okr & oka & okg & okgr & okbw
     )
     # f64 row last: resolve_dtype flips jax_enable_x64 process-globally,
     # which must not perturb the timed f32 rows above
@@ -1405,6 +1581,12 @@ def main() -> int:
         # ABFT silent-corruption checks: healthy-path overhead (≤2%
         # gate) with the 1-psum/iter cadence pinned identical on vs off
         "abft": abft_row,
+        # memory-bandwidth frontier: {f32, bf16-storage} × {pipelined,
+        # sstep} cells — measured GB/s + T_solver + analytic l2 per
+        # cell, the ≤0.6× modeled byte-ratio gate, bf16-vs-f32 l2
+        # parity via the guard's promotion rung; diffed between rounds
+        # by tools/bench_compare.py ([tool.bench_compare] bandwidth-pct)
+        "bandwidth": bw_row,
         # SDF geometry: quadrature-vs-closed-form parity (≤1e-12 frac
         # err, ±2 iters), host assembly overhead, and the composite-
         # domain (ellipse-minus-hole) solve row (geom.*)

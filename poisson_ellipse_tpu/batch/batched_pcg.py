@@ -55,6 +55,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops.precision import (
+    load as _pload,
+    resolve_storage_dtype,
+    store as _pstore,
+)
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD
 
 
@@ -143,16 +148,20 @@ def lane_dots(*pairs):
     return jnp.stack([jnp.sum(u * v, axis=(1, 2)) for u, v in pairs])
 
 
-def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None):
+def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None,
+               storage_dtype=None):
     """The batched PCG carry at iteration 0.
 
     Layout: (k, w, r, p, zr, diff, converged, breakdown, quarantined,
     iters) — the single-engine carry with (B,) per-lane scalars/flags
     plus the quarantine mask and the per-lane completion counter.
     ``h1``/``h2`` may be traced overrides (the bucket-generic path);
-    they default to the problem's.
+    they default to the problem's. ``storage_dtype`` stores the lane
+    fields (w, r, p) at that width (``ops.precision``) — the per-lane
+    scalars stay at compute width.
     """
     dtype = rhs.dtype
+    st = resolve_storage_dtype(storage_dtype, dtype)
     B = rhs.shape[0]
     h1 = jnp.asarray(problem.h1 if h1 is None else h1, dtype)
     h2 = jnp.asarray(problem.h2 if h2 is None else h2, dtype)
@@ -163,9 +172,9 @@ def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None):
     zr0 = jnp.sum(z0 * r0, axis=(1, 2)) * h1 * h2
     return (
         jnp.asarray(0, jnp.int32),
-        jnp.zeros_like(rhs),
-        r0,
-        z0,  # p0 = z0
+        jnp.zeros_like(rhs, dtype=st or rhs.dtype),
+        _pstore(r0, st),
+        _pstore(z0, st),  # p0 = z0
         zr0,
         jnp.full((B,), jnp.inf, dtype),
         jnp.zeros((B,), bool),
@@ -177,7 +186,7 @@ def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None):
 
 def advance(problem: Problem, a, b, rhs, state, limit=None, mask=None,
             h1=None, h2=None, delta=None, stencil: str = "xla",
-            interpret=None):
+            interpret=None, storage_dtype=None):
     """Advance the batched carry until every lane is done or iteration
     ``limit``. Chunked runs (limit=k, k+K, …) are bit-identical to one
     straight run — the ``solver.pcg.advance`` contract, per lane.
@@ -203,6 +212,13 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, mask=None,
             "bucket-generic path)"
         )
     dtype = rhs.dtype
+    st = resolve_storage_dtype(storage_dtype, dtype)
+    if st is not None and stencil != "xla":
+        raise ValueError(
+            "storage_dtype on the batched engines rides the XLA stencil "
+            "(the convert fuses into the consumers); the batched Pallas "
+            "kernel is full-width"
+        )
     h1 = jnp.asarray(problem.h1 if h1 is None else h1, dtype)
     h2 = jnp.asarray(problem.h2 if h2 is None else h2, dtype)
     delta = jnp.asarray(problem.delta if delta is None else delta, dtype)
@@ -218,7 +234,7 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, mask=None,
     d = diag_d_batched(a3, b3, h1, h2, m3)
     body = make_lane_step(a3, b3, d, m3, h1, h2, delta, weighted,
                           stencil=stencil, interpret=interpret,
-                          hs=(problem.h1, problem.h2))
+                          hs=(problem.h1, problem.h2), storage_dtype=st)
 
     def cond(state):
         k, conv, bd, quar = state[0], state[6], state[7], state[8]
@@ -228,7 +244,8 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, mask=None,
 
 
 def make_lane_step(a3, b3, d, m3, h1, h2, delta, weighted,
-                   stencil: str = "xla", interpret=None, hs=None):
+                   stencil: str = "xla", interpret=None, hs=None,
+                   storage_dtype=None):
     """One batched-classical iteration as a carry→carry function.
 
     Factored out of :func:`advance` so the lane-sharded composition
@@ -238,6 +255,13 @@ def make_lane_step(a3, b3, d, m3, h1, h2, delta, weighted,
     kernel (``hs`` supplies the concrete (h1, h2) it bakes in; lane-
     shared coefficients only).
     """
+    st = storage_dtype
+    dtype = jnp.result_type(h1)
+    if st is not None:
+        # operands stream narrow too (the byte cut covers every pass)
+        a3_s, b3_s, d_s = _pstore(a3, st), _pstore(b3, st), _pstore(d, st)
+    else:
+        a3_s, b3_s, d_s = a3, b3, d
     if stencil == "pallas":
         from poisson_ellipse_tpu.ops.pallas_kernels import (
             apply_a_batched_pallas,
@@ -252,12 +276,18 @@ def make_lane_step(a3, b3, d, m3, h1, h2, delta, weighted,
             p, a3[0], b3[0], hs[0], hs[1], interpret=interpret
         )
     elif stencil == "xla":
-        apply_stencil = lambda p: apply_a_batched(p, a3, b3, h1, h2)
+        apply_stencil = lambda p: apply_a_batched(
+            p, _pload(a3_s, dtype, st), _pload(b3_s, dtype, st), h1, h2
+        )
     else:
         raise ValueError(f"unknown stencil: {stencil!r}")
 
     def body(state):
-        k, w, r, p, zr, diff_prev, conv, bd, quar, iters = state
+        k, w_sv, r_sv, p_sv, zr, diff_prev, conv, bd, quar, iters = state
+        # tile-local upcast (identity without a storage dtype)
+        w = _pload(w_sv, dtype, st)
+        r = _pload(r_sv, dtype, st)
+        p = _pload(p_sv, dtype, st)
         active = ~conv & ~bd & ~quar
         ap = apply_stencil(p)
         if m3 is not None:
@@ -271,7 +301,7 @@ def make_lane_step(a3, b3, d, m3, h1, h2, delta, weighted,
         al = alpha[:, None, None]
         w_new = w + al * p
         r_new = r - al * ap
-        z = apply_dinv_batched(r_new, d)
+        z = apply_dinv_batched(r_new, _pload(d_s, dtype, st))
 
         # realised update (w_new − w), one stacked (2, B) reduction —
         # the grid_dots bundle per lane (solver.pcg.advance's fusion)
@@ -302,9 +332,9 @@ def make_lane_step(a3, b3, d, m3, h1, h2, delta, weighted,
         # the update but freezes p/zr (solver.pcg.advance's where tree)
         upd = (active & ~breakdown & ~sick)[:, None, None]
         follow = (active & ~breakdown & ~converged & ~sick)
-        w_out = jnp.where(upd, w_new, w)
-        r_out = jnp.where(upd, r_new, r)
-        p_out = jnp.where(follow[:, None, None], p_new, p)
+        w_out = jnp.where(upd, _pstore(w_new, st), w_sv)
+        r_out = jnp.where(upd, _pstore(r_new, st), r_sv)
+        p_out = jnp.where(follow[:, None, None], _pstore(p_new, st), p_sv)
         zr_out = jnp.where(follow, zr_new, zr)
         diff_out = jnp.where(active & ~sick, diff, diff_prev)
         iters_out = jnp.where(active, k + 1, iters)
@@ -328,7 +358,8 @@ def result_of(state) -> BatchedPCGResult:
 
 
 def pcg_batched(problem: Problem, a, b, rhs, mask=None,
-                stencil: str = "xla", interpret=None) -> BatchedPCGResult:
+                stencil: str = "xla", interpret=None,
+                storage_dtype=None) -> BatchedPCGResult:
     """Run batched PCG for pre-assembled operands.
 
     ``rhs`` is (B, M+1, N+1); ``a``/``b`` are (M+1, N+1) shared or
@@ -337,8 +368,11 @@ def pcg_batched(problem: Problem, a, b, rhs, mask=None,
     lane-on-grid kernel; shared coefficients, f32/bf16 on hardware).
     """
     state = advance(
-        problem, a, b, rhs, init_state(problem, a, b, rhs, mask=mask),
+        problem, a, b, rhs,
+        init_state(problem, a, b, rhs, mask=mask,
+                   storage_dtype=storage_dtype),
         mask=mask, stencil=stencil, interpret=interpret,
+        storage_dtype=storage_dtype,
     )
     return result_of(state)
 

@@ -229,7 +229,9 @@ def _run_inspect(argv: list[str]) -> int:
     ap.add_argument(
         "engine",
         help=f"engine to inspect (single-chip: {', '.join(ENGINES[1:])}; "
-        "sharded via --mode sharded: xla, pallas, fused, pipelined)",
+        "sharded via --mode sharded: xla, pallas, fused, pipelined, "
+        "sstep — sstep reports per-BODY counts (1 psum + 4 ppermute per "
+        "s iterations) alongside the per-iteration division",
     )
     ap.add_argument(
         "--mode", choices=("single", "sharded"), default="single",
@@ -241,6 +243,16 @@ def _run_inspect(argv: list[str]) -> int:
     )
     ap.add_argument("--grid", help="MxN grid to trace at (default 40x40)")
     ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument(
+        "--storage-dtype", choices=("bf16", "f16", "f32"), default=None,
+        help="trace the narrow-storage build: the modeled HBM bytes/iter "
+        "column shows the storage-width byte bill (bf16 under f32 = the "
+        "~2x cut)",
+    )
+    ap.add_argument(
+        "--sstep-s", type=int, choices=(2, 4), default=4,
+        help="s-step block size for the sstep engines",
+    )
     ap.add_argument(
         "--no-xla-cost", action="store_true",
         help="skip the XLA compile + cost analysis (jaxpr counts only)",
@@ -259,6 +271,8 @@ def _run_inspect(argv: list[str]) -> int:
             mode=args.mode,
             mesh_shape=tuple(args.mesh) if args.mesh else None,
             with_xla_cost=not args.no_xla_cost,
+            storage_dtype=args.storage_dtype,
+            sstep_s=args.sstep_s,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -1296,10 +1310,13 @@ def main(argv=None) -> int:
         "one-fused-reduction-per-iteration recurrence (pipelined-pallas: "
         "same loop through the fused stencil+partials kernel); batched/"
         "batched-pipelined run --lanes independent solves per dispatch "
-        "(the throughput engines, per-lane results). Sharded "
+        "(the throughput engines, per-lane results); sstep/sstep-pallas "
+        "run the s-step communication-avoiding recurrence (--sstep-s "
+        "iterations per matrix-powers round). Sharded "
         "mode: xla (default), pallas (the per-shard stencil kernel), "
         "fused (the two-kernel per-shard iteration, f32/bf16), "
-        "pipelined (one stacked psum per iteration), or batched/"
+        "pipelined (one stacked psum per iteration), sstep (ONE psum + "
+        "one s-deep halo per s iterations), or batched/"
         "batched-pipelined with --lanes sharded over the mesh",
     )
     ap.add_argument(
@@ -1329,6 +1346,31 @@ def main(argv=None) -> int:
         "JAX switch: later runs in the same process stay x64-enabled)",
     )
     ap.add_argument("--delta", type=float, default=1e-6)
+    ap.add_argument(
+        "--storage-dtype",
+        choices=("bf16", "f16", "f32"),
+        default=None,
+        metavar="DT",
+        help="HBM storage width for state/operand streams, separate from "
+        "the compute dtype (ops.precision): bf16 halves the loop's HBM "
+        "bytes while every stencil/reduction upcasts to --dtype "
+        "tile-locally. The raw engines converge to the storage floor; "
+        "with --guard the escalation ladder (bf16 -> f32 -> f64) "
+        "promotes the solve to full width before accepting convergence "
+        "— the accuracy-recovered product path. Covers engines "
+        "xla/pallas/pipelined*/sstep*/streamed/xl/batched (sharded: "
+        "sstep)",
+    )
+    ap.add_argument(
+        "--sstep-s",
+        type=int,
+        choices=(2, 4),
+        default=4,
+        metavar="S",
+        help="block size of the s-step engines (--engine sstep/"
+        "sstep-pallas): S iterations per matrix-powers round — sharded, "
+        "ONE psum + one S-deep halo per S iterations",
+    )
     ap.add_argument("--eps", type=float, default=None)
     ap.add_argument(
         "--eps-sweep",
@@ -1643,6 +1685,8 @@ def _run_cli(args) -> int:
                         max_recoveries=args.max_recoveries,
                         geometry=geometry,
                         theta=args.theta,
+                        storage_dtype=args.storage_dtype,
+                        sstep_s=args.sstep_s,
                     )
             except SolveError as e:
                 # the classified exit contract: the trace keeps every

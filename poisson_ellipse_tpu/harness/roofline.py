@@ -51,7 +51,8 @@ def hbm_peak_bytes_per_s(device=None) -> Optional[float]:
     return _HBM_PEAK.get(getattr(device, "device_kind", ""), None)
 
 
-def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
+def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32,
+                    sstep_s: int = 4, storage_dtype=None) -> float:
     """Modelled HBM array-passes per PCG iteration for one engine.
 
     One "pass" = one full node-array read or write against HBM.
@@ -92,9 +93,28 @@ def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
     if engine == "fused":
         return 17.0
     if engine in ("pipelined", "pipelined-pallas"):
-        from poisson_ellipse_tpu.ops.pipelined_pcg import REPLACE_EVERY
+        from poisson_ellipse_tpu.ops.precision import replace_every
 
-        return 25.0 + 4.0 * 5.0 / REPLACE_EVERY
+        # the replacement amortisation follows the EFFECTIVE cadence:
+        # 32 at full width, 8 under sub-compute storage (4× the rebuild
+        # passes — the narrow build's model must carry them)
+        return 25.0 + 4.0 * 5.0 / replace_every(storage_dtype, dtype)
+    if engine in ("sstep", "sstep-pallas"):
+        # per BLOCK of s iterations: 2s−1 Â = D⁻¹A applications (read
+        # v/a/b/dinv, write out: ~6 passes each), one Gram pass over the
+        # K = 2s+1 basis arrays (d rides fused), one reconstruction pass
+        # over the basis + 3 writes; replacement (1 stencil ≈ 5 passes)
+        # amortised over its storage-effective cadence. More bytes/iter
+        # than classical — the engine's win is 1/s collectives, and with
+        # bf16 storage the whole bill halves
+        # (modeled_hbm_bytes_per_iter's storage itemsize).
+        from poisson_ellipse_tpu.ops.precision import replace_every
+
+        s = sstep_s
+        K = 2 * s + 1
+        return ((2 * s - 1) * 6.0 + 2 * K + 3.0) / s + 5.0 / replace_every(
+            storage_dtype, dtype
+        )
     if engine == "xl":
         from poisson_ellipse_tpu.ops.xl_pcg import XLPlan
 
@@ -109,17 +129,30 @@ def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32) -> float:
 
 
 def modeled_hbm_bytes_per_iter(problem: Problem, engine: str,
-                               dtype=jnp.float32) -> float:
+                               dtype=jnp.float32, storage_dtype=None,
+                               sstep_s: int = 4) -> float:
     """The traffic model's HBM bytes per iteration for one engine —
     ``passes_per_iter`` × unpadded node-array bytes. This is the
     "modeled" column ``obs.static_cost`` sets next to XLA's own
     bytes-accessed estimate (the "measured" static column), so model
     drift against the compiler's accounting is visible per engine in
-    ``harness inspect`` instead of only as a bench-day surprise."""
+    ``harness inspect`` instead of only as a bench-day surprise.
+
+    ``storage_dtype`` models the narrow-storage byte bill: the loop
+    engines stream state AND operands at storage width, so every
+    modeled pass narrows by the storage/compute itemsize ratio — bf16
+    under f32 is exactly the ~2× cut the ``bandwidth`` bench key
+    measures. (streamed/xl narrow their operand share only; their
+    modeled figure with storage set is therefore a lower bound for
+    them, labelled as the loop-engine model.)
+    """
+    from poisson_ellipse_tpu.ops.precision import storage_itemsize
+
     g1, g2 = problem.node_shape
     return (
-        passes_per_iter(problem, engine, dtype)
-        * g1 * g2 * jnp.dtype(dtype).itemsize
+        passes_per_iter(problem, engine, dtype, sstep_s=sstep_s,
+                        storage_dtype=storage_dtype)
+        * g1 * g2 * storage_itemsize(dtype, storage_dtype)
     )
 
 
@@ -131,6 +164,8 @@ def roofline(
     dtype=jnp.float32,
     device=None,
     n_devices: int = 1,
+    storage_dtype=None,
+    sstep_s: int = 4,
 ) -> dict:
     """Achieved per-device GB/s + fraction-of-HBM-peak for a measured solve.
 
@@ -140,9 +175,12 @@ def roofline(
     mesh, so the figures are per-chip utilisation against one chip's
     peak; halo-exchange bytes (ICI, not HBM) are not modelled.
     """
+    from poisson_ellipse_tpu.ops.precision import storage_itemsize
+
     g1, g2 = problem.node_shape
-    array_bytes = g1 * g2 * jnp.dtype(dtype).itemsize
-    passes = passes_per_iter(problem, engine, dtype)
+    array_bytes = g1 * g2 * storage_itemsize(dtype, storage_dtype)
+    passes = passes_per_iter(problem, engine, dtype, sstep_s=sstep_s,
+                             storage_dtype=storage_dtype)
     bytes_per_dev = passes * array_bytes * max(iters, 1) / max(n_devices, 1)
     gbps = bytes_per_dev / t_solver / 1e9 if t_solver > 0 else 0.0
     peak = hbm_peak_bytes_per_s(device)

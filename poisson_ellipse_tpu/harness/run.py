@@ -119,6 +119,10 @@ class RunReport:
     lanes: int = 1
     solves_per_sec: float | None = None
     quarantined: int = 0
+    # HBM storage width of the state/operand streams when it differs
+    # from the compute dtype ("bf16": the bandwidth axis, ops.precision);
+    # None = storage == compute, the historical single-dtype run
+    storage_dtype: str | None = None
 
     def summary(self) -> str:
         p = self.problem
@@ -126,7 +130,12 @@ class RunReport:
             f"Grid: {p.M} x {p.N}  (h1={p.h1:.6g}, h2={p.h2:.6g}, "
             f"eps={p.eps_value:.6g}, delta={p.delta:g}, norm={p.norm})",
             f"Mesh: {self.mesh_shape[0]} x {self.mesh_shape[1]}  "
-            f"dtype={self.dtype}  engine={self.engine}",
+            f"dtype={self.dtype}"
+            + (
+                f" (storage {self.storage_dtype})"
+                if self.storage_dtype else ""
+            )
+            + f"  engine={self.engine}",
             (
                 f"Converged after {self.iters} iterations (diff={self.diff:.3e})"
                 if self.converged
@@ -222,6 +231,10 @@ class RunReport:
             **({"threads": self.threads} if self.engine == "native" else {}),
             **({"recoveries": self.recoveries} if self.recoveries else {}),
             **(
+                {"storage_dtype": self.storage_dtype}
+                if self.storage_dtype else {}
+            ),
+            **(
                 {
                     "lanes": self.lanes,
                     "solves_per_sec": self.solves_per_sec,
@@ -250,6 +263,8 @@ def run_once(
     max_recoveries: int = 3,
     geometry=None,
     theta: float | None = None,
+    storage_dtype: str | None = None,
+    sstep_s: int = 4,
 ) -> RunReport:
     """Assemble + solve with fenced init/solver timing.
 
@@ -298,6 +313,17 @@ def run_once(
     """
     if lanes < 1:
         raise ValueError("lanes must be >= 1")
+    if storage_dtype is not None:
+        if mode == "native":
+            raise ValueError(
+                "--storage-dtype rides the JAX engines; the native host "
+                "runtime is f64 end to end"
+            )
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "checkpoint fingerprints do not cover a storage dtype "
+                "yet; drop --checkpoint-dir or --storage-dtype"
+            )
     if geometry is not None and mode == "native":
         raise ValueError(
             "--geometry rides the JAX assembly paths; the native host "
@@ -351,6 +377,14 @@ def run_once(
         )
     if mode not in ("single", "sharded"):
         raise ValueError(f"unknown mode: {mode!r}")
+    if (storage_dtype is not None and mode == "sharded"
+            and engine not in ("sstep", "sstep-pallas") and not guard
+            and timeout is None):
+        raise ValueError(
+            "sharded --storage-dtype covers the sstep engine (whose "
+            "deep-halo exchange ships the narrow state); the classical/"
+            "pipelined/batched sharded forms run full width"
+        )
     if geometry is not None:
         # the gate runs ONCE here for every JAX path (the sharded
         # builders assemble without re-validating, and build_solver is
@@ -396,7 +430,8 @@ def run_once(
         return _run_guarded(
             problem, mode, mesh_shape, dtype, jdtype, engine,
             timeout=timeout, max_recoveries=max_recoveries,
-            geometry=geometry, theta=theta,
+            geometry=geometry, theta=theta, storage_dtype=storage_dtype,
+            sstep_s=sstep_s,
         )
     if checkpoint_dir is not None:
         if repeat > 1 or batch > 1:
@@ -417,6 +452,7 @@ def run_once(
             solver, args, engine = build_solver(
                 problem, engine, jdtype, lanes=lanes, geometry=geometry,
                 theta=theta, validate_geometry=False,
+                storage_dtype=storage_dtype, sstep_s=sstep_s,
             )
             fence(args)
         shape = (1, 1)
@@ -448,6 +484,21 @@ def run_once(
             )
             fence(args)
         shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
+    elif mode == "sharded" and engine in ("sstep", "sstep-pallas"):
+        from poisson_ellipse_tpu.parallel.sstep_sharded import (
+            build_sstep_sharded_solver,
+        )
+
+        with timer.phase("init"):
+            mesh = resolve_mesh(mesh_shape)
+            solver, args = build_sstep_sharded_solver(
+                problem, mesh, jdtype, s=sstep_s,
+                storage_dtype=storage_dtype, geometry=geometry,
+                theta=theta,
+            )
+            engine = "sstep"
+            fence(args)
+        shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
     elif mode == "sharded":
         if engine not in ("auto", "xla", "pallas", "fused", "pipelined"):
             raise ValueError(
@@ -456,10 +507,13 @@ def run_once(
                 "per-shard Pallas stencil kernel ('pallas'), the "
                 "two-kernel fused per-shard iteration ('fused', f32/bf16), "
                 "the one-psum-per-iteration pipelined recurrence "
-                "('pipelined'), or the preconditioned forms ('mg-pcg' / "
+                "('pipelined'), the one-psum-per-s-iterations s-step "
+                "form ('sstep'), or the preconditioned forms ('mg-pcg' / "
                 "'cheb-pcg': V-cycle/Chebyshev per shard, halo-ppermute "
                 "only — the scalar-collective cadence stays classical)"
             )
+        # (narrow-storage sharded requests were already rejected by the
+        # mode-level check above — sstep is the one sharded storage form)
         engine = "xla" if engine == "auto" else engine
         with timer.phase("init"):
             mesh = resolve_mesh(mesh_shape)
@@ -536,6 +590,7 @@ def run_once(
     return _finish_report(
         problem, shape, dtype, jdtype, engine, result, timer, times,
         lanes=lanes, analytic=geometry is None,
+        storage_dtype=storage_dtype, sstep_s=sstep_s,
     )
 
 
@@ -597,6 +652,8 @@ def _run_guarded(
     max_recoveries: int,
     geometry=None,
     theta=None,
+    storage_dtype: str | None = None,
+    sstep_s: int = 4,
 ) -> RunReport:
     """One guarded (and/or deadlined) solve through
     ``resilience.guard.guarded_solve``. Timing is a plain wall clock
@@ -617,6 +674,7 @@ def _run_guarded(
     guarded = guarded_solve(
         problem, engine, jdtype, mesh=mesh, timeout=timeout,
         max_recoveries=max_recoveries, geometry=geometry, theta=theta,
+        storage_dtype=storage_dtype, sstep_s=sstep_s,
     )
     fence(guarded.result)
     t_solve = time.perf_counter() - t0
@@ -624,6 +682,7 @@ def _run_guarded(
     report = _finish_report(
         problem, shape, dtype, jdtype, guarded.engine, guarded.result,
         timer, [t_solve], analytic=geometry is None,
+        storage_dtype=storage_dtype, sstep_s=sstep_s,
     )
     report.recoveries = [event.kind for event in guarded.recoveries]
     return report
@@ -702,6 +761,8 @@ def _finish_report(
     lanes: int = 1,
     quarantined: int = 0,
     analytic: bool = True,
+    storage_dtype: str | None = None,
+    sstep_s: int = 4,
 ) -> RunReport:
     """Shared report tail: L2-vs-analytic, roofline, RunReport assembly.
 
@@ -746,7 +807,8 @@ def _finish_report(
     roof = (
         roofline(
             problem, engine, n, timer.totals["solver"], jdtype,
-            n_devices=shape[0] * shape[1],
+            n_devices=shape[0] * shape[1], storage_dtype=storage_dtype,
+            sstep_s=sstep_s,
         )
         if n > 0 and lanes == 1 and engine not in BATCHED_ENGINES
         else {"passes_per_iter": 0.0, "hbm_gbps": 0.0, "hbm_peak_frac": None}
@@ -768,6 +830,7 @@ def _finish_report(
         lanes=lanes,
         solves_per_sec=solves_per_sec,
         quarantined=quarantined,
+        storage_dtype=storage_dtype,
         **roof,
     )
 

@@ -166,6 +166,9 @@ def load_config(root: Optional[str] = None) -> LintConfig:
         implicit_solver_fns=tuple(
             table.get("implicit-solver-fns", cfg.implicit_solver_fns)
         ),
+        mixed_accum_fns=tuple(
+            table.get("mixed-accum-fns", cfg.mixed_accum_fns)
+        ),
     )
 
 

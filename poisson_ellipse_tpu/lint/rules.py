@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU017.
+"""The tpulint rule registry: TPU001–TPU018.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -72,6 +72,13 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | workaround stores thousands of iterates; the  |
 |        |                    | IFT adjoint (`diff.adjoint.solve_implicit`)   |
 |        |                    | is one extra solve with the same operator     |
+| TPU018 | silent-downcast    | a bf16/f16 value (`.astype(bfloat16)` result  |
+|        |                    | or arithmetic over such values) flows into a  |
+|        |                    | reduction with no f32/f64 accumulator route — |
+|        |                    | 8-mantissa-bit accumulation loses digits      |
+|        |                    | linearly in n; upcast first, pass a wide      |
+|        |                    | `dtype=`, or route via `mixed-accum-fns` (the |
+|        |                    | storage-vs-compute fence of `ops.precision`)  |
 """
 
 from __future__ import annotations
@@ -153,6 +160,14 @@ class LintConfig:
     implicit_solver_fns: tuple[str, ...] = (
         "solve_implicit", "solve_operands", "*ImplicitSolver*",
         "custom_linear_solve",
+    )
+    # TPU018: sanctioned mixed-precision reducers (fnmatch patterns) —
+    # callables that take narrow (bf16/f16) operands but accumulate at
+    # f32/f64 internally (the mixed Pallas kernels, ops.precision's
+    # helpers). A narrow value flowing into one of these is the
+    # designed route, not a silent downcast.
+    mixed_accum_fns: tuple[str, ...] = (
+        "*_mixed_pallas", "*.precision.load", "*.precision.store",
     )
 
 
@@ -2389,3 +2404,197 @@ def check_backprop_through_loop(module: Module,
             "(`diff.adjoint.solve_implicit` / `ImplicitSolver.solve`: "
             "the adjoint is one extra solve with the same operator)",
         )
+
+
+# --------------------------------------------------------------------------
+# TPU018 — half-width values flowing into a reduction without a wide
+# accumulator route
+# --------------------------------------------------------------------------
+
+# dtype spellings that mean "16-bit float" — the storage widths whose
+# accumulation error grows like n·2⁻⁸ instead of n·2⁻²⁴
+_NARROW_DTYPE_LEAVES = frozenset({"bfloat16", "float16"})
+_NARROW_DTYPE_STRINGS = frozenset({"bfloat16", "float16", "bf16", "f16"})
+_WIDE_DTYPE_LEAVES = frozenset({"float32", "float64"})
+_WIDE_DTYPE_STRINGS = frozenset({"float32", "float64", "f32", "f64"})
+
+# built-in reduction sinks (the TPU007 reduction_roots knob extends the
+# set with a project's own grid_dot-style wrappers)
+_REDUCTION_SINKS = frozenset({
+    "jax.numpy.sum", "jax.numpy.mean", "jax.numpy.dot", "jax.numpy.vdot",
+    "jax.numpy.einsum", "jax.numpy.matmul", "jax.numpy.tensordot",
+    "jax.numpy.inner", "jax.lax.psum", "numpy.sum", "numpy.dot",
+    "numpy.einsum",
+})
+
+
+def _dtype_class(module: Module, node: ast.AST) -> Optional[str]:
+    """"narrow" / "wide" / None for a dtype expression, when statically
+    visible (an attribute like jnp.bfloat16, or a string literal)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value in _NARROW_DTYPE_STRINGS:
+            return "narrow"
+        if node.value in _WIDE_DTYPE_STRINGS:
+            return "wide"
+        return None
+    leaf = None
+    if isinstance(node, ast.Attribute):
+        leaf = node.attr
+    elif isinstance(node, ast.Name):
+        leaf = node.id
+    if leaf in _NARROW_DTYPE_LEAVES:
+        return "narrow"
+    if leaf in _WIDE_DTYPE_LEAVES:
+        return "wide"
+    return None
+
+
+def _astype_class(module: Module, node: ast.AST) -> Optional[str]:
+    """The dtype class of an ``x.astype(...)`` call, else None."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "astype"
+        and node.args
+    ):
+        return _dtype_class(module, node.args[0])
+    return None
+
+
+def _expr_is_narrow(module: Module, node: ast.AST,
+                    narrow_names: set) -> bool:
+    """Does this expression statically carry a 16-bit float value all
+    the way to its root? Conservative: anything unresolvable reads as
+    not-narrow (the registry's stay-silent stance). An inner
+    ``.astype(f32/f64)`` re-widens the value and stops the flow."""
+    cls = _astype_class(module, node)
+    if cls == "narrow":
+        return True
+    if cls == "wide":
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in narrow_names
+    if isinstance(node, ast.BinOp):
+        left = _expr_is_narrow(module, node.left, narrow_names)
+        right = _expr_is_narrow(module, node.right, narrow_names)
+        if left and right:
+            return True
+        # narrow ∘ python-scalar stays narrow under weak-type promotion;
+        # narrow ∘ wide promotes wide (not a finding)
+        if left and isinstance(node.right, ast.Constant):
+            return True
+        if right and isinstance(node.left, ast.Constant):
+            return True
+        return False
+    if isinstance(node, ast.UnaryOp):
+        return _expr_is_narrow(module, node.operand, narrow_names)
+    if isinstance(node, ast.Subscript):
+        return _expr_is_narrow(module, node.value, narrow_names)
+    if isinstance(node, ast.Call):
+        # abs/negative-style elementwise wrappers keep the dtype; treat
+        # only jnp.abs / abs conservatively, everything else opaque
+        q = module.qualname(node.func) or ""
+        if q in ("jax.numpy.abs", "abs") and node.args:
+            return _expr_is_narrow(module, node.args[0], narrow_names)
+        return False
+    return False
+
+
+def _scan_scope_tpu018(module: Module, config: LintConfig, body,
+                       mixed_fns: tuple[str, ...]):
+    """Walk one scope's statements in order, tracking names bound to
+    narrow values, yielding reductions fed by them."""
+    reduction_roots = tuple(_REDUCTION_SINKS) + tuple(config.reduction_roots)
+    narrow_names: set = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            q = module.qualname(node.func) or ""
+            leaf = (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else getattr(node.func, "id", "")
+            )
+            if _matches_fn(module, node.func, mixed_fns):
+                continue  # a blessed wide-accumulator route
+            is_sink = any(
+                fnmatch.fnmatch(q, pat) or fnmatch.fnmatch(leaf, pat)
+                for pat in reduction_roots
+            ) or q in _REDUCTION_SINKS
+            if not is_sink:
+                continue
+            # an explicit wide accumulator silences the sink
+            if any(
+                kw.arg == "dtype"
+                and _dtype_class(module, kw.value) == "wide"
+                for kw in node.keywords
+            ):
+                continue
+            for arg in node.args:
+                if _expr_is_narrow(module, arg, narrow_names):
+                    yield node, leaf or q
+                    break
+        # statement-order narrowness tracking (after scanning: a
+        # reduction inside the RHS sees the PRE-assignment bindings)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            if _expr_is_narrow(module, stmt.value, narrow_names):
+                narrow_names.add(name)
+            else:
+                narrow_names.discard(name)
+
+
+@rule(
+    "TPU018",
+    "silent-downcast",
+    "a bf16/f16 value (an .astype(bfloat16/float16) result, or "
+    "arithmetic over such values) flows into a reduction with no "
+    "f32/f64 accumulator route — the sum accumulates at 8 mantissa "
+    "bits and loses digits linearly in n",
+)
+def check_silent_downcast(module: Module,
+                          config: LintConfig) -> Iterator[Finding]:
+    """The storage-vs-compute precision fence (``ops.precision``). The
+    bf16-storage contract is narrow in HBM, WIDE in every accumulator:
+    a reduction whose operand tree is statically 16-bit (an
+    ``.astype(jnp.bfloat16)``/"bf16" result, a name bound to one, or
+    arithmetic over such values) accumulates at 8 mantissa bits —
+    round-off grows like n·2⁻⁸ and a grid-sized sum is wrong in the
+    third digit. The route out is an upcast before the reduction
+    (``.astype(jnp.float32)``, fused by XLA into the consumer — free on
+    the HBM side), an explicit ``dtype=jnp.float32`` accumulator on the
+    reduction itself, or one of the configured ``mixed-accum-fns`` —
+    the project's sanctioned mixed-precision reducers (the Pallas mixed
+    kernels, ``ops.precision``'s load/store helpers).
+
+    Conservative per the registry's standing rules: dtypes must be
+    statically visible (attribute or string literal), unresolvable
+    expressions read as not-narrow, and only same-scope, statement-
+    ordered name bindings propagate narrowness.
+    """
+    mixed_fns = config.mixed_accum_fns
+    scopes = [module.tree.body]
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node.body)
+    seen: set = set()
+    for body in scopes:
+        for call, sink in _scan_scope_tpu018(module, config, body,
+                                             mixed_fns):
+            key = (call.lineno, call.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _finding(
+                module,
+                call,
+                "TPU018",
+                f"`{sink}` reduces a bf16/f16-typed operand with no "
+                "f32/f64 accumulator route — 8 mantissa bits lose "
+                "digits linearly in element count. Upcast first "
+                "(`.astype(jnp.float32)` fuses into the consumer: the "
+                "HBM read stays narrow), pass `dtype=jnp.float32` to "
+                "the reduction, or route through a `mixed-accum-fns` "
+                "helper (ops.precision / the mixed Pallas kernels)",
+            )

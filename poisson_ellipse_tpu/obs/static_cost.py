@@ -48,8 +48,15 @@ COLLECTIVE_PRIMS = (
 )
 
 SHARDED_ENGINES = (
-    "xla", "pallas", "fused", "pipelined", "mg-pcg", "cheb-pcg",
+    "xla", "pallas", "fused", "pipelined", "mg-pcg", "cheb-pcg", "sstep",
 )
+
+# iterations advanced per while-loop body: the s-step engines run s
+# iterations per body (matrix-powers block), every other engine runs 1.
+# Collective counts read from a while body divide by this to become
+# per-ITERATION figures — the denominator every cadence claim uses.
+def iters_per_loop_body(engine: str, sstep_s: int = 4) -> int:
+    return sstep_s if engine in ("sstep", "sstep-pallas") else 1
 
 
 # -- jaxpr walking -----------------------------------------------------------
@@ -168,12 +175,16 @@ def xla_cost(fn, args) -> dict | None:
 # -- the per-engine report ---------------------------------------------------
 
 
-def _build(problem: Problem, engine: str, dtype, mode: str, mesh_shape):
+def _build(problem: Problem, engine: str, dtype, mode: str, mesh_shape,
+           storage_dtype=None, sstep_s: int = 4):
     """(fn, args) through the same entry points the product runs."""
     if mode == "single":
         from poisson_ellipse_tpu.solver.engine import build_solver
 
-        solver, args, _ = build_solver(problem, engine, dtype)
+        solver, args, _ = build_solver(
+            problem, engine, dtype, storage_dtype=storage_dtype,
+            sstep_s=sstep_s,
+        )
         return solver, args
     if mode == "sharded":
         from poisson_ellipse_tpu.harness.run import resolve_mesh
@@ -185,6 +196,20 @@ def _build(problem: Problem, engine: str, dtype, mode: str, mesh_shape):
                 f"(sharded engines: {', '.join(SHARDED_ENGINES)})"
             )
         mesh = resolve_mesh(mesh_shape)
+        if engine == "sstep":
+            from poisson_ellipse_tpu.parallel.sstep_sharded import (
+                build_sstep_sharded_solver,
+            )
+
+            return build_sstep_sharded_solver(
+                problem, mesh, dtype, s=sstep_s,
+                storage_dtype=storage_dtype,
+            )
+        if storage_dtype is not None:
+            raise ValueError(
+                "sharded storage-dtype tracing covers the sstep engine; "
+                "the classical/pipelined sharded forms run full width"
+            )
         if engine in ("mg-pcg", "cheb-pcg"):
             from poisson_ellipse_tpu.parallel.mg_sharded import (
                 build_mg_sharded_solver,
@@ -211,6 +236,8 @@ def engine_report(
     mode: str = "single",
     mesh_shape: tuple[int, int] | None = None,
     with_xla_cost: bool = True,
+    storage_dtype=None,
+    sstep_s: int = 4,
 ) -> dict:
     """One engine's static cost record.
 
@@ -221,30 +248,57 @@ def engine_report(
     backend exposes no cost analysis); and the roofline traffic model's
     ``modeled_passes_per_iter`` / ``modeled_hbm_bytes_per_iter`` for the
     measured-vs-modeled comparison.
+
+    The s-step engines advance ``sstep_s`` iterations per loop body;
+    their per-iteration counts divide the body counts by
+    ``iters_per_body`` (reported, with the raw body counts kept in
+    ``psum_per_body``/``ppermute_per_body`` — the jaxpr-pinned facts).
+    ``storage_dtype`` reports the narrow-storage build: the modeled HBM
+    bytes column shows the storage-width byte bill (the ~2× cut the
+    bandwidth bench key measures end to end).
     """
     from poisson_ellipse_tpu.harness.roofline import (
         modeled_hbm_bytes_per_iter,
         passes_per_iter,
     )
 
-    fn, args = _build(problem, engine, dtype, mode, mesh_shape)
+    from poisson_ellipse_tpu.ops.precision import resolve_storage_dtype
+
+    storage_dtype = resolve_storage_dtype(storage_dtype, dtype)
+    fn, args = _build(problem, engine, dtype, mode, mesh_shape,
+                      storage_dtype=storage_dtype, sstep_s=sstep_s)
     counts = loop_primitive_counts(fn, args)
     cost = xla_cost(fn, args) if with_xla_cost else None
     try:
-        passes = passes_per_iter(problem, engine, dtype)
-        modeled_bytes = modeled_hbm_bytes_per_iter(problem, engine, dtype)
+        passes = passes_per_iter(problem, engine, dtype, sstep_s=sstep_s,
+                                 storage_dtype=storage_dtype)
+        modeled_bytes = modeled_hbm_bytes_per_iter(
+            problem, engine, dtype, storage_dtype=storage_dtype,
+            sstep_s=sstep_s,
+        )
     except ValueError:  # an engine without a traffic model stays reportable
         passes, modeled_bytes = None, None
     # psum and its invariant-spelled twin are one collective on the wire
     psum = counts.get("psum", 0) + counts.get("psum_invariant", 0)
+    per_body = iters_per_loop_body(engine, sstep_s)
     return {
         "engine": engine,
         "mode": mode,
         "grid": [problem.M, problem.N],
         "dtype": jnp.dtype(dtype).name,
+        "storage_dtype": (
+            jnp.dtype(storage_dtype).name if storage_dtype is not None
+            else None
+        ),
         "mesh": list(mesh_shape) if mesh_shape is not None else None,
-        "psum_per_iter": psum,
-        "ppermute_per_iter": counts.get("ppermute", 0),
+        "iters_per_body": per_body,
+        "psum_per_body": psum,
+        "ppermute_per_body": counts.get("ppermute", 0),
+        "psum_per_iter": psum / per_body if per_body > 1 else psum,
+        "ppermute_per_iter": (
+            counts.get("ppermute", 0) / per_body
+            if per_body > 1 else counts.get("ppermute", 0)
+        ),
         "collectives_per_iter": {k: v for k, v in counts.items() if v},
         "flops_per_iter_est": cost["flops"] if cost else None,
         "hbm_bytes_per_iter_est": cost["bytes_accessed"] if cost else None,
@@ -288,12 +342,21 @@ def render_report(rep: dict) -> str:
         if rep["mode"] == "sharded" and rep["mesh"]
         else rep["mode"]
     )
+    storage = rep.get("storage_dtype")
     lines = [
         f"engine {rep['engine']} ({where}), grid "
-        f"{rep['grid'][0]}x{rep['grid'][1]}, dtype {rep['dtype']}:",
-        f"  psum/iter      {rep['psum_per_iter']}",
-        f"  ppermute/iter  {rep['ppermute_per_iter']}",
+        f"{rep['grid'][0]}x{rep['grid'][1]}, dtype {rep['dtype']}"
+        + (f" (storage {storage})" if storage else "")
+        + ":",
+        f"  psum/iter      {rep['psum_per_iter']:g}",
+        f"  ppermute/iter  {rep['ppermute_per_iter']:g}",
     ]
+    if rep.get("iters_per_body", 1) > 1:
+        lines.append(
+            f"  per while-body ({rep['iters_per_body']} iters): "
+            f"{rep['psum_per_body']} psum, {rep['ppermute_per_body']} "
+            "ppermute (the jaxpr-pinned s-step cadence)"
+        )
     extra = {
         k: v
         for k, v in rep["collectives_per_iter"].items()

@@ -704,3 +704,241 @@ def update_p_pallas(beta, z, p, interpret=None):
         out_shape=jax.ShapeDtypeStruct((k, g2), p.dtype),
         interpret=interpret,
     )(jnp.reshape(beta, (1,)), z_p, p_p)[:g1]
+
+
+# --------------------------------------------------------------------------
+# mixed-precision kernels: storage-width HBM tiles, compute-width VMEM math
+# --------------------------------------------------------------------------
+#
+# The bf16-storage axis (``ops.precision``): arrays live at storage width
+# in HBM — halving the stencil's dominant byte stream — and every tile is
+# upcast to the compute dtype *after* the DMA, inside VMEM, so the
+# arithmetic (and the SMEM dot accumulators) run at full precision. These
+# are the explicitly-tiled twins of what the XLA path gets from fusing a
+# ``convert_element_type`` into the consumer; the FP expression tree is
+# the same term-for-term stencil as ``_stencil_kernel``, evaluated at
+# compute width on upcast operands.
+
+
+def _stencil_kernel_mixed(h1, h2, tm, bn, compute, w_hbm, a_hbm, b_hbm,
+                          out_ref, w_s, a_s, b_s, sems):
+    """One TM-row stencil tile: storage-width windows, compute-width math."""
+    r0 = pl.program_id(0) * tm
+    copies = [
+        pltpu.make_async_copy(src.at[pl.ds(r0, tm + 8), :], dst, sems.at[i])
+        for i, (src, dst) in enumerate(
+            [(w_hbm, w_s), (a_hbm, a_s), (b_hbm, b_s)]
+        )
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
+
+    # the tile-local upcast: the DMA moved storage-width bytes; the VPU
+    # sees compute-width operands from here on
+    w_c = w_s[:].astype(compute)
+    a_c = a_s[:].astype(compute)
+    b_c = b_s[:].astype(compute)
+    wc = w_c[1 : tm + 1, 1 : bn + 1]
+    ax = -(
+        a_c[2 : tm + 2, 1 : bn + 1] * (w_c[2 : tm + 2, 1 : bn + 1] - wc) / h1
+        - a_c[1 : tm + 1, 1 : bn + 1] * (wc - w_c[0:tm, 1 : bn + 1]) / h1
+    ) / h1
+    ay = -(
+        b_c[1 : tm + 1, 2 : bn + 2] * (w_c[1 : tm + 1, 2 : bn + 2] - wc) / h2
+        - b_c[1 : tm + 1, 1 : bn + 1] * (wc - w_c[1 : tm + 1, 0:bn]) / h2
+    ) / h2
+    out_ref[:] = (ax + ay).astype(out_ref.dtype)
+
+
+def apply_a_block_mixed_pallas(w_ext, a_ext, b_ext, h1, h2,
+                               compute_dtype=jnp.float32, out_dtype=None,
+                               interpret=None, vma=None):
+    """Mixed-precision A·w over a halo-extended block.
+
+    Inputs may each carry their own (storage) dtype — bf16 state with
+    bf16-rounded coefficients is the intended pairing — and are upcast
+    tile-locally to ``compute_dtype`` in VMEM; the output is written at
+    ``out_dtype`` (default: ``compute_dtype``, so downstream reductions
+    see full-width values). Alignment/tiling contract is
+    ``apply_a_block_pallas``'s.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    out_dtype = jnp.dtype(out_dtype or compute_dtype)
+    bm = w_ext.shape[0] - 2
+    bn = w_ext.shape[1] - 2
+    n_tiles = -(-bm // TILE_ROWS)
+    tm = round_up(-(-bm // n_tiles), 8)
+    k = round_up(bm, tm)
+    cols = round_up(bn + 2, 128)
+    pad = ((0, k + 8 - (bm + 2)), (0, cols - (bn + 2)))
+    w_p = jnp.pad(w_ext, pad)
+    a_p = jnp.pad(a_ext, pad)
+    b_p = jnp.pad(b_ext, pad)
+    kernel = functools.partial(
+        _stencil_kernel_mixed, float(h1), float(h2), tm, bn,
+        jnp.dtype(compute_dtype),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(k // tm,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=pl.BlockSpec(
+            (tm, bn), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=shape_dtype_struct((k, bn), out_dtype, vma=vma),
+        scratch_shapes=[
+            pltpu.VMEM((tm + 8, cols), w_p.dtype),
+            pltpu.VMEM((tm + 8, cols), a_p.dtype),
+            pltpu.VMEM((tm + 8, cols), b_p.dtype),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=interpret,
+    )(w_p, a_p, b_p)
+    return out[:bm]
+
+
+def apply_a_mixed_pallas(w, a, b, h1, h2, compute_dtype=jnp.float32,
+                         out_dtype=None, interpret=None):
+    """Full-node-grid mixed stencil: storage-width (M+1, N+1) inputs,
+    compute-width interior output with a zero boundary ring."""
+    return jnp.pad(
+        apply_a_block_mixed_pallas(
+            w, a, b, h1, h2, compute_dtype=compute_dtype,
+            out_dtype=out_dtype, interpret=interpret,
+        ),
+        1,
+    )
+
+
+def _stencil_dots_kernel_mixed(h1, h2, tm, bn, n_pairs, n_tiles, compute,
+                               *refs):
+    """Mixed twin of ``_stencil_dots_kernel``: storage-width operands,
+    compute-width stencil arithmetic AND dot accumulation (the SMEM
+    accumulator is compute-width — the f32 accumulator route TPU018
+    lints for)."""
+    w_hbm, a_hbm, b_hbm = refs[0:3]
+    pair_refs = refs[3 : 3 + 2 * n_pairs]
+    out_ref, sums_out = refs[3 + 2 * n_pairs : 5 + 2 * n_pairs]
+    w_s, a_s, b_s, sems, acc = refs[5 + 2 * n_pairs :]
+
+    i = pl.program_id(0)
+    r0 = i * tm
+    copies = [
+        pltpu.make_async_copy(src.at[pl.ds(r0, tm + 8), :], dst, sems.at[k])
+        for k, (src, dst) in enumerate(
+            [(w_hbm, w_s), (a_hbm, a_s), (b_hbm, b_s)]
+        )
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
+
+    w_c = w_s[:].astype(compute)
+    a_c = a_s[:].astype(compute)
+    b_c = b_s[:].astype(compute)
+    wc = w_c[1 : tm + 1, 1 : bn + 1]
+    ax = -(
+        a_c[2 : tm + 2, 1 : bn + 1] * (w_c[2 : tm + 2, 1 : bn + 1] - wc) / h1
+        - a_c[1 : tm + 1, 1 : bn + 1] * (wc - w_c[0:tm, 1 : bn + 1]) / h1
+    ) / h1
+    ay = -(
+        b_c[1 : tm + 1, 2 : bn + 2] * (w_c[1 : tm + 1, 2 : bn + 2] - wc) / h2
+        - b_c[1 : tm + 1, 1 : bn + 1] * (wc - w_c[1 : tm + 1, 0:bn]) / h2
+    ) / h2
+    out_ref[:] = (ax + ay).astype(out_ref.dtype)
+
+    @pl.when(i == 0)
+    def _():
+        for j in range(n_pairs):
+            acc[j] = jnp.zeros((), compute)
+
+    for j in range(n_pairs):
+        acc[j] += jnp.sum(
+            pair_refs[2 * j][:].astype(compute)
+            * pair_refs[2 * j + 1][:].astype(compute)
+        )
+
+    @pl.when(i == n_tiles - 1)
+    def _():
+        for j in range(n_pairs):
+            sums_out[j] = acc[j]
+
+
+def apply_a_block_dots_mixed_pallas(w_ext, a_ext, b_ext, h1, h2, pairs,
+                                    compute_dtype=jnp.float32,
+                                    interpret=None, vma=None):
+    """Mixed fused stencil + dot-partials pass over a halo-extended block.
+
+    The storage-axis twin of ``apply_a_block_dots_pallas``: every operand
+    (stencil inputs AND the 2·n_pairs dot operands) may stream at its own
+    storage width and is upcast tile-locally; the stencil output and the
+    (n_pairs,) partial sums come back at ``compute_dtype`` — reductions
+    never accumulate at storage width (the TPU018 contract).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    pairs = tuple(pairs)
+    n_pairs = len(pairs)
+    if n_pairs == 0:
+        raise ValueError("need at least one (x, y) dot pair")
+    compute = jnp.dtype(compute_dtype)
+    bm = w_ext.shape[0] - 2
+    bn = w_ext.shape[1] - 2
+    n_tiles = -(-bm // TILE_ROWS)
+    tm = round_up(-(-bm // n_tiles), 8)
+    k = round_up(bm, tm)
+    cols = round_up(bn + 2, 128)
+    pad = ((0, k + 8 - (bm + 2)), (0, cols - (bn + 2)))
+    w_p = jnp.pad(w_ext, pad)
+    a_p = jnp.pad(a_ext, pad)
+    b_p = jnp.pad(b_ext, pad)
+    flat = []
+    for x, y in pairs:
+        flat += [jnp.pad(x, ((0, k - bm), (0, 0))),
+                 jnp.pad(y, ((0, k - bm), (0, 0)))]
+    blk = lambda: pl.BlockSpec(
+        (tm, bn), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _stencil_dots_kernel_mixed, float(h1), float(h2), tm, bn, n_pairs,
+        k // tm, compute,
+    )
+    out, sums = pl.pallas_call(
+        kernel,
+        grid=(k // tm,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3
+        + [blk() for _ in range(2 * n_pairs)],
+        out_specs=(
+            pl.BlockSpec((tm, bn), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            shape_dtype_struct((k, bn), compute, vma=vma),
+            shape_dtype_struct((n_pairs,), compute, vma=vma),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tm + 8, cols), w_p.dtype),
+            pltpu.VMEM((tm + 8, cols), a_p.dtype),
+            pltpu.VMEM((tm + 8, cols), b_p.dtype),
+            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.SMEM((n_pairs,), compute),
+        ],
+        interpret=interpret,
+    )(w_p, a_p, b_p, *flat)
+    return out[:bm], sums
+
+
+def apply_a_dots_mixed_pallas(w, a, b, h1, h2, pairs,
+                              compute_dtype=jnp.float32, interpret=None):
+    """Full-node-grid twin of ``apply_a_block_dots_mixed_pallas`` (ring
+    cropped off the dot operands exactly as ``apply_a_dots_pallas``)."""
+    cropped = tuple((x[1:-1, 1:-1], y[1:-1, 1:-1]) for x, y in pairs)
+    out, sums = apply_a_block_dots_mixed_pallas(
+        w, a, b, h1, h2, cropped, compute_dtype=compute_dtype,
+        interpret=interpret,
+    )
+    return jnp.pad(out, 1), sums

@@ -65,6 +65,12 @@ from poisson_ellipse_tpu.obs.convergence import (
     trace_of,
 )
 from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.precision import (
+    load as _pload,
+    replace_every,
+    resolve_storage_dtype,
+    store as _pstore,
+)
 from poisson_ellipse_tpu.ops.reduction import grid_dots
 from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
 from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
@@ -77,11 +83,14 @@ from poisson_ellipse_tpu.solver.pcg import DENOM_GUARD, PCGResult
 # iterations in (Ghysels & Vanroose §4.3's residual replacement, on a
 # fixed cadence so chunked advances stay bit-identical to straight runs).
 # Amortised cost: 4/32 ≈ 0.13 extra stencil passes per iteration.
+# Under a sub-compute storage_dtype the cadence tightens (every store
+# rounds at the storage floor): ``ops.precision.replace_every`` keys the
+# period on the effective dtype — this constant is the f32 value.
 REPLACE_EVERY = 32
 
 
 def init_state(problem: Problem, a, b, rhs, stencil: str = "xla",
-               interpret=None, history: bool = False):
+               interpret=None, history: bool = False, storage_dtype=None):
     """The pipelined carry at iteration 0 (the resumable solver state).
 
     Layout: (k, x, r, u, w, z, s, p, γ₋₁, diff, converged, breakdown).
@@ -93,19 +102,20 @@ def init_state(problem: Problem, a, b, rhs, stencil: str = "xla",
     buffers; the core layout is untouched.
     """
     dtype = rhs.dtype
+    st = resolve_storage_dtype(storage_dtype, dtype)
     d = diag_d(a, b, jnp.asarray(problem.h1, dtype), jnp.asarray(problem.h2, dtype))
     apply_stencil = _stencil_fn(problem, a, b, d, stencil, dtype, interpret)
     r0 = rhs
     u0 = apply_dinv(r0, d)
     w0 = apply_stencil(u0)
-    zeros = jnp.zeros_like(rhs)
+    zeros = jnp.zeros_like(rhs, dtype=st or rhs.dtype)
     one = jnp.asarray(1.0, dtype)
     state = (
         jnp.asarray(0, jnp.int32),
         zeros,  # x
-        r0,
-        u0,
-        w0,
+        _pstore(r0, st),
+        _pstore(u0, st),
+        _pstore(w0, st),
         zeros,  # z
         zeros,  # s
         zeros,  # p
@@ -142,7 +152,8 @@ def _stencil_fn(problem: Problem, a, b, d, stencil: str, dtype,
 
 
 def advance(problem: Problem, a, b, rhs, state, limit=None,
-            stencil: str = "xla", interpret=None, history: bool = False):
+            stencil: str = "xla", interpret=None, history: bool = False,
+            storage_dtype=None):
     """Advance the pipelined carry until convergence/breakdown or
     iteration ``limit`` (defaults to max_iterations).
 
@@ -154,6 +165,8 @@ def advance(problem: Problem, a, b, rhs, state, limit=None,
     pure extra stores, iterates bit-identical either way.
     """
     dtype = rhs.dtype
+    st = resolve_storage_dtype(storage_dtype, dtype)
+    replace_cadence = replace_every(st, dtype)
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     hw = h1 * h2
@@ -168,17 +181,53 @@ def advance(problem: Problem, a, b, rhs, state, limit=None,
     )
     d = diag_d(a, b, h1, h2)
     apply_stencil = _stencil_fn(problem, a, b, d, stencil, dtype, interpret)
+    # operands stream at storage width when a storage dtype is set (the
+    # upcast fuses into the consumers — reads stay narrow)
+    a_s, b_s = (_pstore(a, st), _pstore(b, st)) if st is not None else (a, b)
+    d_s = _pstore(d, st) if st is not None else d
+    if st is not None and stencil == "xla":
+        # the storage-width stencil: operands read narrow, upcast fused
+        def apply_stencil(m):  # noqa: F811 — replaces the full-width closure
+            return apply_a(m, _pload(a_s, dtype, st), _pload(b_s, dtype, st),
+                           h1, h2)
 
     if stencil == "pallas":
-        from poisson_ellipse_tpu.ops.pallas_kernels import apply_a_dots_pallas
-
-        def stencil_and_dots(m, r, u, w, s, p):
-            # one VMEM pass: n = A·m AND the eight dot partials, every
-            # operand read from HBM exactly once
-            return apply_a_dots_pallas(
-                m, a, b, problem.h1, problem.h2, _bundle(r, u, w, s, p),
-                interpret=interpret,
+        if st is not None:
+            from poisson_ellipse_tpu.ops.pallas_kernels import (
+                apply_a_dots_mixed_pallas,
+                apply_a_mixed_pallas,
             )
+
+            # replacement rebuilds apply the SAME storage-rounded
+            # operator the in-loop kernel applies (operator consistency)
+            def apply_stencil(m):  # noqa: F811
+                return apply_a_mixed_pallas(
+                    m, a_s, b_s, problem.h1, problem.h2,
+                    compute_dtype=dtype, interpret=interpret,
+                )
+
+            def stencil_and_dots(m, r, u, w, s, p):
+                # mixed one-VMEM-pass form: the dot operands stream at
+                # storage width and are upcast tile-locally; partials
+                # accumulate at compute width in SMEM
+                stored = tuple(_pstore(v, st) for v in (r, u, w, s, p))
+                return apply_a_dots_mixed_pallas(
+                    m, a_s, b_s, problem.h1, problem.h2, _bundle(*stored),
+                    compute_dtype=dtype, interpret=interpret,
+                )
+
+        else:
+            from poisson_ellipse_tpu.ops.pallas_kernels import (
+                apply_a_dots_pallas,
+            )
+
+            def stencil_and_dots(m, r, u, w, s, p):
+                # one VMEM pass: n = A·m AND the eight dot partials, every
+                # operand read from HBM exactly once
+                return apply_a_dots_pallas(
+                    m, a, b, problem.h1, problem.h2, _bundle(r, u, w, s, p),
+                    interpret=interpret,
+                )
 
     else:  # "xla" (anything else was rejected by _stencil_fn above)
 
@@ -196,19 +245,30 @@ def advance(problem: Problem, a, b, rhs, state, limit=None,
         iteration counter, so chunking cannot move it."""
 
         def rebuilt(_):
+            # dinv resolves at call time: the rebuild divides by the SAME
+            # (possibly storage-rounded) D the in-loop recurrence uses
             r_t = rhs - apply_stencil(x)
-            u_t = apply_dinv(r_t, d)
+            u_t = dinv(r_t)
             s_t = apply_stencil(p)
             return (
                 r_t, u_t, apply_stencil(u_t),
-                apply_stencil(apply_dinv(s_t, d)), s_t,
+                apply_stencil(dinv(s_t)), s_t,
             )
 
-        do = (k > 0) & (k % REPLACE_EVERY == 0)
+        do = (k > 0) & (k % replace_cadence == 0)
         return lax.cond(do, rebuilt, lambda _: (r, u, w, z, s), None)
 
+    def dinv(v):
+        # under a storage dtype D streams narrow too; the load fuses
+        return apply_dinv(v, _pload(d_s, dtype, st) if st is not None else d)
+
     def body(state):
-        k, x, r, u, w, z, s, p, g_prev, diff_prev, _c, _bd = state[:12]
+        k, x_s, r_sv, u_sv, w_sv, z_sv, s_sv, p_sv, g_prev, diff_prev, \
+            _c, _bd = state[:12]
+        # tile-local upcast (identity when st is None)
+        x = _pload(x_s, dtype, st)
+        r, u, w = (_pload(v, dtype, st) for v in (r_sv, u_sv, w_sv))
+        z, s, p = (_pload(v, dtype, st) for v in (z_sv, s_sv, p_sv))
         r, u, w, z, s = replace(k, x, r, u, w, z, s, p, rhs)
 
         # the iteration's one fused reduction (γ and the α/norm terms)
@@ -216,7 +276,7 @@ def advance(problem: Problem, a, b, rhs, state, limit=None,
         # dependence on the reduction, so on a mesh XLA overlaps the
         # psum with the halo exchange + stencil
         # (parallel.pipelined_sharded); here they share one fusion pass
-        m = apply_dinv(w, d)
+        m = dinv(w)
         n, sums = stencil_and_dots(m, r, u, w, s, p)
         gamma = sums[0] * hw
         wu, wp, su, sp = sums[1], sums[2], sums[3], sums[4]
@@ -238,7 +298,7 @@ def advance(problem: Problem, a, b, rhs, state, limit=None,
         p_new = u + beta * p
         x_new = x + alpha * p_new
         r_new = r - alpha * s_new
-        u_new = u - alpha * apply_dinv(s_new, d)
+        u_new = u - alpha * dinv(s_new)
         w_new = w - alpha * z_new
 
         # ‖Δx‖ = α‖p⁺‖ from the bundle (no extra pass over x)
@@ -249,13 +309,15 @@ def advance(problem: Problem, a, b, rhs, state, limit=None,
         diff = jnp.where(breakdown, diff_prev, diff)
 
         # a breakdown iteration discards its update entirely (the
-        # reference exits before touching w/r)
-        keep = lambda old, new: jnp.where(breakdown, old, new)
+        # reference exits before touching w/r); updates round back to
+        # storage width on store (identity when st is None)
+        keep = lambda old, new: jnp.where(breakdown, old, _pstore(new, st))
         out = (
             k + 1,
-            keep(x, x_new), keep(r, r_new), keep(u, u_new), keep(w, w_new),
-            keep(z, z_new), keep(s, s_new), keep(p, p_new),
-            keep(g_prev, gamma),
+            keep(x_s, x_new), keep(r_sv, r_new), keep(u_sv, u_new),
+            keep(w_sv, w_new), keep(z_sv, z_new), keep(s_sv, s_new),
+            keep(p_sv, p_new),
+            jnp.where(breakdown, g_prev, gamma),
             diff, converged, breakdown,
         )
         if history:
@@ -290,7 +352,8 @@ def result_of(state) -> PCGResult:
 
 
 def pcg_pipelined(problem: Problem, a, b, rhs, stencil: str = "xla",
-                  interpret=None, history: bool = False):
+                  interpret=None, history: bool = False,
+                  storage_dtype=None):
     """Run pipelined PCG for pre-assembled coefficients ((M+1, N+1) grids).
 
     Jit-safe with ``problem`` static; the while_loop carries
@@ -304,8 +367,9 @@ def pcg_pipelined(problem: Problem, a, b, rhs, stencil: str = "xla",
     state = advance(
         problem, a, b, rhs,
         init_state(problem, a, b, rhs, stencil=stencil, interpret=interpret,
-                   history=history),
+                   history=history, storage_dtype=storage_dtype),
         stencil=stencil, interpret=interpret, history=history,
+        storage_dtype=storage_dtype,
     )
     result = result_of(state)
     if history:

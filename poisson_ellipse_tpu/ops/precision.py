@@ -1,0 +1,141 @@
+"""Storage-vs-compute precision policy: bf16 state in HBM, f32 math.
+
+BENCH_r05 put the streamed engines at 82% of HBM peak at 2400×3200 —
+per-iteration wall clock there is *bytes moved*, and every iterate (w,
+r, p, z, …) plus the streamed operands (a, b, D) crosses HBM once or
+more per iteration. Halving the width of everything that streams halves
+the iteration's byte bill; the catch is that CG's recurrences are not
+stable in bf16 arithmetic. The contract this module names is therefore
+**storage ≠ compute**:
+
+- arrays *live* in ``storage_dtype`` (bf16: 8-bit exponent — same
+  dynamic range as f32, 8 mantissa bits) in HBM,
+- every stencil application, axpy and reduction *upcasts to the compute
+  dtype first* (tile-locally: XLA fuses the ``convert_element_type``
+  into the consumer, so HBM reads stay storage-width; the Pallas mixed
+  kernels do the same upcast explicitly in VMEM), and accumulates in
+  compute precision,
+- results are rounded back to storage width on store.
+
+Accuracy is then *recovered, not hoped for*: the storage rounding floor
+(~bf16 eps per store) is answered by (a) a tightened residual-
+replacement cadence (:func:`replace_every`) for the recurrence engines,
+(b) the guard's escalation ladder growing a ``bf16 → f32`` rung below
+the existing ``f32 → f64`` one, and (c) a storage *promotion* on
+convergence — a solve that stops inside bf16's floor is re-anchored and
+polished at full compute width before the guard will return it
+(``resilience.guard``), so the returned iterate meets the same final
+true-residual gate as a full-precision run. The ABFT shadow recurrences
+(``resilience.abft``) double as the low-precision drift alarm: their
+rtol is keyed on the *effective* (storage) itemsize via
+:func:`effective_dtype`.
+
+``storage_dtype=None`` everywhere means "storage == compute": the
+traced computation is byte-identical to the pre-storage-axis code
+(jaxpr-pinned in ``tests/test_sstep.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# storage dtypes the axis accepts: half-width floats (the point), plus
+# the identity widths so `--storage-dtype f32` is expressible
+STORAGE_DTYPES = ("bf16", "f16", "f32", "f64")
+
+_NAMES = {
+    "bf16": jnp.bfloat16,
+    "f16": jnp.float16,
+    "f32": jnp.float32,
+    # "f64" only names the identity storage width for f64-compute runs;
+    # resolve_storage_dtype rejects any storage WIDER than compute, so a
+    # silent downcast cannot hide in this table entry
+    "f64": jnp.float64,  # tpulint: disable=TPU001
+}
+
+
+def resolve_storage_dtype(storage_dtype, compute_dtype):
+    """Normalise a storage-dtype request against the compute dtype.
+
+    Accepts a name ("bf16"), a dtype, or None. Returns a jnp dtype or
+    None — None meaning "storage == compute", which every consumer
+    treats as the exact pre-storage-axis code path. A storage dtype
+    *wider* than compute is refused: the axis exists to shrink HBM
+    bytes, and silently computing in less precision than the state is
+    stored at would invert the accuracy contract.
+    """
+    if storage_dtype is None:
+        return None
+    if isinstance(storage_dtype, str):
+        if storage_dtype in _NAMES:
+            storage_dtype = _NAMES[storage_dtype]
+        else:
+            try:  # canonical dtype names ("bfloat16", "float16", …)
+                storage_dtype = jnp.dtype(storage_dtype)
+            except TypeError:
+                raise ValueError(
+                    f"unknown storage dtype {storage_dtype!r} "
+                    f"(choose from {', '.join(STORAGE_DTYPES)})"
+                ) from None
+    st = jnp.dtype(storage_dtype)
+    if not jnp.issubdtype(st, jnp.floating):
+        raise ValueError(
+            f"storage dtype must be floating, got {st.name}"
+        )
+    st = jnp.dtype(storage_dtype)
+    ct = jnp.dtype(compute_dtype)
+    if st == ct:
+        return None
+    if st.itemsize > ct.itemsize:
+        raise ValueError(
+            f"storage dtype {st.name} is wider than compute dtype "
+            f"{ct.name}; storage exists to shrink HBM traffic — widen "
+            "the compute dtype instead"
+        )
+    return jnp.dtype(st)
+
+
+def store(x, storage_dtype):
+    """Round to storage width (identity when storage is None)."""
+    return x if storage_dtype is None else x.astype(storage_dtype)
+
+
+def load(x, compute_dtype, storage_dtype):
+    """Upcast a stored array to compute width (identity when None).
+
+    The upcast is free on the HBM side: XLA fuses the convert into the
+    consuming op, so the array is read at storage width and widened in
+    registers/VMEM — the tile-local upcast the Pallas mixed kernels
+    spell explicitly.
+    """
+    return x if storage_dtype is None else x.astype(compute_dtype)
+
+
+def effective_dtype(compute_dtype, storage_dtype):
+    """The dtype whose rounding floor governs the solve's drift — the
+    storage dtype when one is set (every store rounds there), else the
+    compute dtype. ABFT rtols and replacement cadences key on this.
+    Accepts the short storage names ("bf16") as well as dtypes."""
+    st = resolve_storage_dtype(storage_dtype, compute_dtype)
+    return compute_dtype if st is None else st
+
+
+def replace_every(storage_dtype=None, compute_dtype=jnp.float32) -> int:
+    """Residual-replacement cadence (iterations) for the recurrence
+    engines (pipelined, s-step).
+
+    f32 storage drifts at ~2⁻²⁴/store and 32 iterations between
+    ground-truth rebuilds bounds it (the measured
+    ``ops.pipelined_pcg.REPLACE_EVERY`` fact); bf16/f16 storage rounds
+    at ~2⁻⁸ per store, so the cadence tightens 4× — 8 iterations —
+    keeping the recurrence-vs-truth gap in the same relative band.
+    Both values divide the s-step block sizes (s ∈ {2, 4}), so a
+    replacement always lands on a block boundary.
+    """
+    eff = jnp.dtype(effective_dtype(compute_dtype, storage_dtype))
+    return 8 if eff.itemsize <= 2 else 32
+
+
+def storage_itemsize(compute_dtype, storage_dtype=None) -> int:
+    """Bytes per element as actually stored in HBM."""
+    return jnp.dtype(effective_dtype(compute_dtype, storage_dtype)).itemsize

@@ -256,10 +256,19 @@ def _mega_kernel(problem: Problem, plan: StreamPlan, weighted: bool,
         )
 
     def _read(name, t, slot, rows):
-        """Tile rows of a (possibly resident) operand after its wait."""
+        """Tile rows of a (possibly resident) operand after its wait.
+
+        The single operand-consumption chokepoint — which is where the
+        storage axis lands: operand buffers typed at storage width
+        (``build_streamed_solver(storage_dtype=…)``) are upcast
+        tile-locally here, so the DMA stream (HBM bytes) stays narrow
+        and the VPU arithmetic stays at compute width.
+        """
         if res[name]:
-            return _BUF[name][pl.ds(t * tm, rows), :]
-        return _BUF[name][pl.ds(slot * _ALLOC[name], rows), :]
+            out = _BUF[name][pl.ds(t * tm, rows), :]
+        else:
+            out = _BUF[name][pl.ds(slot * _ALLOC[name], rows), :]
+        return out.astype(dtype) if out.dtype != dtype else out
 
     def _pipelined(loaders, compute, carry0):
         """fori_loop over tiles with all streamed loads double-buffered."""
@@ -564,18 +573,30 @@ def streamed_operand_set(problem: Problem, dtype, g1p: int, g2p: int,
 
 def build_streamed_solver(problem: Problem, dtype=jnp.float32,
                           interpret=None, tm: int | None = None,
-                          geometry=None, theta=None):
+                          geometry=None, theta=None, storage_dtype=None):
     """(jitted whole-solve kernel, args) for large grids.
 
     args = (dinv, a, b, r0), all f64-assembled and rounded once (same
     operand fidelity as ``fused_pcg.build_fused_solver``).
     tm — row-tile height (see StreamPlan).
+
+    ``storage_dtype`` (``ops.precision``): the state (w, r, p) is
+    VMEM-resident here, so the engine's per-iteration HBM traffic IS the
+    streamed operand set — a narrow storage dtype stores dinv/a/b at
+    that width and the kernel upcasts each tile after its DMA
+    (``_read``), cutting the per-iteration bytes by the storage ratio.
+    r0 stays at compute width (read once per solve, not per iteration).
     """
+    from poisson_ellipse_tpu.ops.precision import resolve_storage_dtype
+
     if jnp.dtype(dtype).itemsize >= 8:
         raise ValueError("streamed solver supports f32/bf16")
+    st = resolve_storage_dtype(storage_dtype, dtype)
     if interpret is None:
         interpret = _interpret_default()
     g1, g2 = problem.node_shape
+    # the plan budgets buffers at compute width — conservative under a
+    # narrow storage dtype (the operand buffers shrink, never grow)
     plan = StreamPlan(problem, dtype, tm=tm)
     if not plan.fits:
         raise ValueError(
@@ -587,6 +608,12 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
     g1p, g2p, tm = plan.g1p, plan.g2p, plan.tm
     args = streamed_operand_set(problem, dtype, g1p, g2p,
                                 geometry=geometry, theta=theta)
+    if st is not None:
+        dinv0, a0, b0, r00 = args
+        args = (
+            jnp.asarray(dinv0).astype(st), jnp.asarray(a0).astype(st),
+            jnp.asarray(b0).astype(st), r00,
+        )
 
     kernel = functools.partial(
         _mega_kernel, problem, plan, problem.norm == "weighted"
@@ -595,10 +622,12 @@ def build_streamed_solver(problem: Problem, dtype=jnp.float32,
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     res = plan.resident
     # resident operands hold the full padded array; streamed ones get a
-    # 2-slot double buffer — row counts come from the plan (one source)
+    # 2-slot double buffer — row counts come from the plan (one source).
+    # Operand buffers match the (possibly narrow) storage width; ap is
+    # iteration state and stays at compute width.
     buf = lambda name: pltpu.VMEM(
         ((plan.full_rows if res[name] else plan.tile_rows)[name], g2p),
-        dtype,
+        st if (st is not None and name in ("dinv", "a", "b")) else dtype,
     )
     call = pl.pallas_call(
         kernel,

@@ -161,7 +161,12 @@ def _mega_kernel(problem: Problem, plan: XLPlan, weighted: bool,
 
     def tile_of(buf, slot, rows=None):
         rows = tm if rows is None else rows
-        return buf[pl.ds(slot * rows, rows), :]
+        out = buf[pl.ds(slot * rows, rows), :]
+        # operand buffers may be typed at a narrow storage width
+        # (``build_xl_solver(storage_dtype=…)``): upcast tile-locally so
+        # the arithmetic stays at compute width while the DMA stream —
+        # this engine's bottleneck — stays narrow
+        return out.astype(dtype) if out.dtype != dtype else out
 
     # -- one-time init sweep: w = 0, p = 0, z = r0*Dinv, zr0 ---------------
     # serial (one-time cost); w_buf doubles as the zero source.
@@ -400,16 +405,26 @@ def _mega_kernel(problem: Problem, plan: XLPlan, weighted: bool,
 
 def build_xl_solver(problem: Problem, dtype=jnp.float32, interpret=None,
                     tm: int | None = None, _debug_raw: bool = False,
-                    geometry=None, theta=None):
+                    geometry=None, theta=None, storage_dtype=None):
     """(jitted whole-solve kernel, args) for state-beyond-VMEM grids.
 
     args = (dinv, a, b, r0): f64-assembled, rounded once — the shared
     operand fidelity contract (``fused_pcg.build_fused_solver``).
     _debug_raw returns the raw pallas outputs (w, iters, diff, flags,
     z, p, ap) — the HBM state scratch is inspectable for tests/debug.
+
+    ``storage_dtype`` (``ops.precision``) streams the coefficient
+    operands (dinv, a, b) at that width, upcast per tile inside the
+    kernel (``tile_of``); the HBM state scratch stays at compute width —
+    the operand share of this engine's ~12 passes/iter narrows, the
+    state share keeps full precision (the conservative rung; the full
+    state-narrow form is the sharded/sstep engines' territory).
     """
+    from poisson_ellipse_tpu.ops.precision import resolve_storage_dtype
+
     if jnp.dtype(dtype).itemsize >= 8:
         raise ValueError("xl solver supports f32/bf16; use engine='xla'")
+    st = resolve_storage_dtype(storage_dtype, dtype)
     if interpret is None:
         interpret = _interpret_default()
     g1, g2 = problem.node_shape
@@ -417,14 +432,21 @@ def build_xl_solver(problem: Problem, dtype=jnp.float32, interpret=None,
     g1p, g2p, tm = plan.g1p, plan.g2p, plan.tm
     args = streamed_operand_set(problem, dtype, g1p, g2p,
                                 geometry=geometry, theta=theta)
+    if st is not None:
+        dinv0, a0, b0, r00 = args
+        args = (
+            jnp.asarray(dinv0).astype(st), jnp.asarray(a0).astype(st),
+            jnp.asarray(b0).astype(st), r00,
+        )
 
     kernel = functools.partial(
         _mega_kernel, problem, plan, problem.norm == "weighted"
     )
     anyspec = lambda: pl.BlockSpec(memory_space=pl.ANY)
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
-    tile = lambda slots, rows=None: pltpu.VMEM(
-        (slots * (rows if rows else tm), g2p), dtype
+    tile = lambda slots, rows=None, narrow=False: pltpu.VMEM(
+        (slots * (rows if rows else tm), g2p),
+        st if (narrow and st is not None) else dtype,
     )
     call = pl.pallas_call(
         kernel,
@@ -446,12 +468,12 @@ def build_xl_solver(problem: Problem, dtype=jnp.float32, interpret=None,
             tile(2),            # w_buf
             tile(2),            # wout_buf
             tile(3),            # ring (pn)
-            tile(2, tm + 8),    # a_buf
-            tile(2),            # b_buf
+            tile(2, tm + 8, narrow=True),    # a_buf
+            tile(2, narrow=True),            # b_buf
             tile(2),            # ap_buf
             tile(2),            # zc_buf
             tile(2),            # zcout_buf
-            tile(2),            # dv_buf
+            tile(2, narrow=True),            # dv_buf
             tile(2),            # apc_buf
             pltpu.SMEM((3,), dtype),
             pltpu.SemaphoreType.DMA((_NSEMS,)),

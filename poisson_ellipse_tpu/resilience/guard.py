@@ -206,6 +206,17 @@ class _ClassicalAdapter:
     ladder is mg-pcg → cheb-pcg → diag classical: a V-cycle poisoned by
     a NaN in a coarse level degrades to the polynomial rung, then to
     the reference preconditioner that every oracle is pinned against.
+
+    ``sstep_s`` (2 or 4) swaps the advance for the s-step recurrence
+    (``ops.sstep_pcg`` — the carry layout is deliberately identical),
+    engine name "sstep"/"sstep-pallas"; its fallback is
+    sstep → pipelined (carry handoff, ``_to_pipelined``) → classical.
+
+    ``storage_dtype`` (``ops.precision``) runs the narrow-storage loop;
+    the adapter's escalation then has a rung BELOW f64 — *storage
+    promotion* back to compute width (``promote``), which the guard
+    also applies on convergence/progress-stall so a narrow solve always
+    FINISHES at full width (accuracy recovered, not hoped).
     """
 
     FIELDS = {"w": 1, "r": 2, "p": 3, "zr": 4}
@@ -213,7 +224,9 @@ class _ClassicalAdapter:
 
     def __init__(self, problem: Problem, dtype, stencil: str = "xla",
                  interpret=None, operands=None, precond_kind=None,
-                 precond_config=None, geometry=None, theta=None):
+                 precond_config=None, geometry=None, theta=None,
+                 storage_dtype=None, sstep_s=None):
+        from poisson_ellipse_tpu.ops.precision import resolve_storage_dtype
         from poisson_ellipse_tpu.solver.pcg import (
             advance as pcg_advance,
             init_state as pcg_init_state,
@@ -226,6 +239,8 @@ class _ClassicalAdapter:
         self.precond_kind = precond_kind
         self.geometry = geometry
         self.theta = theta
+        self.storage_dtype = resolve_storage_dtype(storage_dtype, dtype)
+        self.sstep_s = sstep_s
         self._precond_cfg = None
         if precond_kind is not None:
             from poisson_ellipse_tpu.solver.engine import (
@@ -233,6 +248,8 @@ class _ClassicalAdapter:
             )
 
             self.engine = PRECOND_ENGINE_BY_KIND[precond_kind]
+        elif sstep_s is not None:
+            self.engine = "sstep" if stencil == "xla" else "sstep-pallas"
         else:
             self.engine = "xla" if stencil == "xla" else "pallas"
         a, b, rhs = (
@@ -255,15 +272,26 @@ class _ClassicalAdapter:
         else:
             precond = None
         self.rhs_norm = float(jnp.sqrt(jnp.sum(rhs.astype(jnp.float32) ** 2)))
+        st = self.storage_dtype
         self._init = lambda: pcg_init_state(
-            problem, a, b, rhs, precond=precond
+            problem, a, b, rhs, precond=precond, storage_dtype=st
         )
         # the raw chunk closure IS the production advance — exposed
         # unjitted so tests can pin the guarded jaxpr against it
-        self.advance_fn = lambda state, limit: pcg_advance(
-            problem, a, b, rhs, state, limit=limit, stencil=stencil,
-            precond=precond,
-        )
+        if sstep_s is not None:
+            from poisson_ellipse_tpu.ops.sstep_pcg import (
+                advance as sstep_advance,
+            )
+
+            self.advance_fn = lambda state, limit: sstep_advance(
+                problem, a, b, rhs, state, s=sstep_s, limit=limit,
+                stencil=stencil, interpret=interpret, storage_dtype=st,
+            )
+        else:
+            self.advance_fn = lambda state, limit: pcg_advance(
+                problem, a, b, rhs, state, limit=limit, stencil=stencil,
+                precond=precond, storage_dtype=st,
+            )
         # one compiled advance per adapter, the bound traced (no
         # recompile per chunk); carry not donated — the guard keeps the
         # previous healthy carry alive as the rollback point
@@ -277,14 +305,23 @@ class _ClassicalAdapter:
             # true residual restart KEEPING the search direction (the
             # residual-replacement form — see module docstring); the
             # rebuilt z goes through the SAME preconditioner, so the
-            # restarted recurrence still describes M⁻¹A
-            k, w, _r, p, _zr, diff, _c, _bd = state[:8]
+            # restarted recurrence still describes M⁻¹A. A narrow-
+            # storage carry is upcast for the rebuild (ground truth is
+            # computed at full width) and re-rounded on store.
+            from poisson_ellipse_tpu.ops.precision import (
+                load as _pld,
+                store as _pst,
+            )
+
+            k, w_s, _r, p_s, _zr, diff, _c, _bd = state[:8]
+            w = _pld(w_s, dtype, st)
+            p = _pld(p_s, dtype, st)
             r2 = rhs - apply_a(w, a, b, h1, h2)
             z2 = apply_dinv(r2, d) if precond is None else precond(r2)
             zr2 = grid_dot(z2, r2, h1, h2)
             p2 = jnp.where(jnp.all(jnp.isfinite(p)), p, z2)
             return (
-                k, w, r2, p2, zr2, diff,
+                k, w_s, _pst(r2, st), _pst(p2, st), zr2, diff,
                 jnp.asarray(False), jnp.asarray(False),
             )
 
@@ -310,10 +347,49 @@ class _ClassicalAdapter:
 
         return result_of(state)
 
+    def promote(self):
+        """Storage promotion — the bf16→f32 rung of the escalation
+        ladder and the mandatory finishing step of every narrow solve:
+        the ITERATE hands over to the full-width classical loop, the
+        DIRECTION restarts from the rebuilt z. Keeping the narrow
+        direction is not an option: it carries only storage-mantissa
+        digits, and feeding it to the full-width α = zr/(Ap,p) breaks
+        conjugacy and diverges (measured — the same lesson as the
+        pipelined→classical phase correction). The NaN'd p slot routes
+        recover() into its p = z branch."""
+        if self.storage_dtype is None:
+            return None
+        adapter = _ClassicalAdapter(
+            self.problem, self.dtype, stencil="xla",
+            operands=self._operands, geometry=self.geometry,
+            theta=self.theta,
+        )
+        dtype = self.dtype
+
+        def convert(state):
+            x = state[1].astype(dtype)
+            return (
+                state[0], x, jnp.zeros_like(x),
+                jnp.full_like(x, jnp.nan),
+                jnp.asarray(1.0, dtype), state[5].astype(dtype),
+                jnp.asarray(False), jnp.asarray(False),
+            )
+
+        return adapter, convert
+
     def escalate(self):
+        if self.storage_dtype is not None:
+            # the rung BELOW f64: back to compute width first —
+            # breakdown/stagnation under narrow storage is almost always
+            # the storage floor, not an f32 phenomenon
+            return self.promote()
         if self.precond_kind is not None:
             # the preconditioner engines walk their own ladder
             # (mg → cheb → diag, see fallback) before any dtype change
+            return None
+        if self.sstep_s is not None:
+            # the s-step ladder is fallback-first (sstep → pipelined →
+            # classical); precision escalation belongs to the floor rung
             return None
         if self.stencil != "xla" or jnp.dtype(self.dtype).itemsize >= 8:
             return None
@@ -328,6 +404,42 @@ class _ClassicalAdapter:
         return adapter, lambda state: _cast_carry(state, jnp.float64)
 
     def fallback(self):
+        if self.sstep_s is not None:
+            # sstep → pipelined: the carry hands over through a ground-
+            # truth rebuild (classical layout in, pipelined layout out —
+            # x and the direction p carry across; the pipelined
+            # adapter's own fallback continues the ladder to classical)
+            adapter = _PipelinedAdapter(
+                self.problem, self.dtype, stencil="xla",
+                geometry=self.geometry, theta=self.theta,
+            )
+            a, b, rhs = self._operands
+            h1 = jnp.asarray(self.problem.h1, self.dtype)
+            h2 = jnp.asarray(self.problem.h2, self.dtype)
+            d = diag_d(a, b, h1, h2)
+            dtype, st = self.dtype, self.storage_dtype
+
+            def to_pipelined(state):
+                from poisson_ellipse_tpu.ops.precision import load as _pld
+
+                k, zr, diff = state[0], state[4], state[5]
+                x = _pld(state[1], dtype, st)
+                p = _pld(state[3], dtype, st)
+                r2 = rhs - apply_a(x, a, b, h1, h2)
+                u2 = apply_dinv(r2, d)
+                w2 = apply_a(u2, a, b, h1, h2)
+                s2 = apply_a(p, a, b, h1, h2)
+                z2 = apply_a(apply_dinv(s2, d), a, b, h1, h2)
+                g2 = jnp.where(
+                    jnp.isfinite(zr) & (zr > 0), zr,
+                    jnp.asarray(1.0, zr.dtype),
+                )
+                return (
+                    k, x, r2, u2, w2, z2, s2, p, g2, diff,
+                    jnp.asarray(False), jnp.asarray(False),
+                )
+
+            return adapter, jax.jit(to_pipelined)  # tpulint: disable=TPU006
         if self.precond_kind == "mg":
             # the carry layout is shared, so the iterate/direction hand
             # straight over; recover() rebuilds z/zr under the new M.
@@ -376,8 +488,10 @@ class _PipelinedAdapter:
     K, ZR, DIFF, CONV, BD = 0, 8, 9, 10, 11
 
     def __init__(self, problem: Problem, dtype, stencil: str = "xla",
-                 interpret=None, geometry=None, theta=None):
+                 interpret=None, geometry=None, theta=None,
+                 storage_dtype=None):
         from poisson_ellipse_tpu.ops import pipelined_pcg as _pp
+        from poisson_ellipse_tpu.ops.precision import resolve_storage_dtype
 
         self.problem = problem
         self.dtype = dtype
@@ -385,17 +499,20 @@ class _PipelinedAdapter:
         self.interpret = interpret
         self.geometry = geometry
         self.theta = theta
+        self.storage_dtype = resolve_storage_dtype(storage_dtype, dtype)
+        st = self.storage_dtype
         self.engine = "pipelined" if stencil == "xla" else "pipelined-pallas"
         a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
                                       theta=theta)
         self._operands = (a, b, rhs)
         self.rhs_norm = float(jnp.sqrt(jnp.sum(rhs.astype(jnp.float32) ** 2)))
         self._init = lambda: _pp.init_state(
-            problem, a, b, rhs, stencil=stencil, interpret=interpret
+            problem, a, b, rhs, stencil=stencil, interpret=interpret,
+            storage_dtype=st,
         )
         self.advance_fn = lambda state, limit: _pp.advance(
             problem, a, b, rhs, state, limit=limit, stencil=stencil,
-            interpret=interpret,
+            interpret=interpret, storage_dtype=st,
         )
         self.advance = jax.jit(self.advance_fn)  # tpulint: disable=TPU006
 
@@ -406,8 +523,17 @@ class _PipelinedAdapter:
         def recover(state):
             # the in-loop residual replacement's rebuild, applied on
             # demand: every recurrence-maintained vector from ground
-            # truth, direction p kept (ops.pipelined_pcg.replace)
-            k, x, _r, _u, _w, _z, _s, p, g, diff, _c, _bd = state[:12]
+            # truth, direction p kept (ops.pipelined_pcg.replace); a
+            # narrow-storage carry rebuilds at full width, re-rounded
+            # on store
+            from poisson_ellipse_tpu.ops.precision import (
+                load as _pld,
+                store as _pst,
+            )
+
+            k, x_s, _r, _u, _w, _z, _s_, p_s, g, diff, _c, _bd = state[:12]
+            x = _pld(x_s, dtype, st)
+            p = _pld(p_s, dtype, st)
             r2 = rhs - apply_a(x, a, b, h1, h2)
             u2 = apply_dinv(r2, d)
             w2 = apply_a(u2, a, b, h1, h2)
@@ -415,7 +541,8 @@ class _PipelinedAdapter:
             z2 = apply_a(apply_dinv(s2, d), a, b, h1, h2)
             g2 = jnp.where(jnp.isfinite(g), g, jnp.asarray(1.0, g.dtype))
             return (
-                k, x, r2, u2, w2, z2, s2, p, g2, diff,
+                k, x_s, _pst(r2, st), _pst(u2, st), _pst(w2, st),
+                _pst(z2, st), _pst(s2, st), p_s, g2, diff,
                 jnp.asarray(False), jnp.asarray(False),
             )
 
@@ -440,9 +567,15 @@ class _PipelinedAdapter:
             # stale direction to the classical α = zr/(Ap,p) breaks the
             # (r, p) = (z, r) invariant and diverges (measured) — so the
             # conversion applies the classical end-of-iteration direction
-            # update once: p₀ = z + (zr/γ)·p.
-            k, x = state[0], state[1]
-            p, g, diff = state[7], state[8], state[9]
+            # update once: p₀ = z + (zr/γ)·p. A narrow-storage carry is
+            # upcast here: the fault-path fallback always lands at full
+            # width (conservative — correctness before bandwidth).
+            from poisson_ellipse_tpu.ops.precision import load as _pld
+
+            k = state[0]
+            x = _pld(state[1], dtype, st)
+            p = _pld(state[7], dtype, st)
+            g, diff = state[8], state[9]
             r2 = rhs - apply_a(x, a, b, h1, h2)
             z2 = apply_dinv(r2, d)
             zr2 = grid_dot(z2, r2, h1, h2)
@@ -465,7 +598,36 @@ class _PipelinedAdapter:
 
         return result_of(state)
 
+    def promote(self):
+        """Storage promotion: iterate hands over to the full-width
+        classical loop, direction restarts from z (see the classical
+        adapter's promote — the narrow direction must not survive the
+        precision boundary)."""
+        if self.storage_dtype is None:
+            return None
+        adapter = _ClassicalAdapter(
+            self.problem, self.dtype, stencil="xla",
+            operands=self._operands, geometry=self.geometry,
+            theta=self.theta,
+        )
+        dtype = self.dtype
+
+        def convert(state):
+            x = state[1].astype(dtype)  # the pipelined carry's iterate
+            return (
+                state[0], x, jnp.zeros_like(x),
+                jnp.full_like(x, jnp.nan),
+                jnp.asarray(1.0, dtype), state[9].astype(dtype),
+                jnp.asarray(False), jnp.asarray(False),
+            )
+
+        return adapter, convert
+
     def escalate(self):
+        if self.storage_dtype is not None:
+            # back to compute width before any f64 talk (the bf16→f32
+            # rung; stagnation under narrow storage is the storage floor)
+            return self.promote()
         if self.stencil != "xla" or jnp.dtype(self.dtype).itemsize >= 8:
             return None
         if not jax.config.jax_enable_x64:
@@ -510,7 +672,7 @@ class _ShardedAdapter:
     SDC = ABFT_SDC  # the abft-module-owned shadow-tail layout
 
     def __init__(self, problem: Problem, mesh, dtype, stencil: str = "xla",
-                 abft: bool = False, precond_kind=None):
+                 abft: bool = False, precond_kind=None, sstep_s=None):
         from poisson_ellipse_tpu.parallel.pcg_sharded import (
             build_sharded_recover,
             build_sharded_stepper,
@@ -522,6 +684,8 @@ class _ShardedAdapter:
         self.stencil = stencil
         self.abft = abft
         self.precond_kind = precond_kind
+        self.sstep_s = sstep_s
+        self.storage_dtype = None  # the mesh ladder runs at full width
         if precond_kind is not None:
             from poisson_ellipse_tpu.parallel.mg_sharded import (
                 build_mg_sharded_stepper,
@@ -535,6 +699,22 @@ class _ShardedAdapter:
                 build_mg_sharded_stepper(
                     problem, mesh, dtype, kind=precond_kind, abft=abft
                 )
+            )
+        elif stencil == "sstep":
+            # the s-step stepper shares the classical carry layout, so
+            # the CLASSICAL recover applies verbatim (rebuild r/z/zr,
+            # keep p, re-anchor the abft tail) — the whole point of
+            # pinning the layouts together
+            from poisson_ellipse_tpu.parallel.sstep_sharded import (
+                build_sstep_sharded_stepper,
+            )
+
+            self.engine = "sstep"
+            self._init, self.advance = build_sstep_sharded_stepper(
+                problem, mesh, dtype, s=sstep_s or 4, abft=abft
+            )
+            self.recover = build_sharded_recover(
+                problem, mesh, dtype, stencil_impl="xla", abft=abft
             )
         else:
             self.engine = stencil
@@ -611,7 +791,9 @@ class _ShardedAdapter:
                 return carry
 
             return adapter, convert
-        if self.stencil == "pallas":
+        if self.stencil in ("pallas", "sstep"):
+            # same carry layout: the iterate/direction hand straight
+            # over (sstep → the classical 2-psum stepper; pallas → xla)
             adapter = _ShardedAdapter(
                 self.problem, self.mesh, self.dtype, stencil="xla",
                 abft=self.abft,
@@ -649,6 +831,7 @@ class _PipelinedShardedAdapter:
         self.dtype = dtype
         self.stencil = "xla"
         self.abft = abft
+        self.storage_dtype = None  # the mesh ladder runs at full width
         self.engine = "pipelined"
         self._init, self.advance = build_pipelined_sharded_stepper(
             problem, mesh, dtype, abft=abft
@@ -759,7 +942,8 @@ class _PipelinedShardedAdapter:
 
 
 def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
-                  abft: bool = False, geometry=None, theta=None):
+                  abft: bool = False, geometry=None, theta=None,
+                  storage_dtype=None, sstep_s: int = 4):
     if geometry is not None and mesh is not None:
         raise ValueError(
             "guarded sharded solves do not take geometry= yet — run the "
@@ -773,12 +957,22 @@ def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
             "are guarded by the health word + final residual gate alone"
         )
     if mesh is not None:
+        if storage_dtype is not None:
+            raise ValueError(
+                "the guarded mesh ladder runs at full width; narrow-"
+                "storage sharded solves run the steppers directly "
+                "(parallel.pcg_sharded / parallel.sstep_sharded with "
+                "storage_dtype=) — drop --storage-dtype or --mesh"
+            )
         if engine in ("auto", "xla"):
             return _ShardedAdapter(problem, mesh, dtype, stencil="xla",
                                    abft=abft)
         if engine == "pallas":
             return _ShardedAdapter(problem, mesh, dtype, stencil="pallas",
                                    abft=abft)
+        if engine in ("sstep", "sstep-pallas"):
+            return _ShardedAdapter(problem, mesh, dtype, stencil="sstep",
+                                   abft=abft, sstep_s=sstep_s)
         if engine in ("mg-pcg", "cheb-pcg"):
             from poisson_ellipse_tpu.solver.engine import (
                 PRECOND_KIND_BY_ENGINE,
@@ -792,16 +986,34 @@ def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
             return _PipelinedShardedAdapter(problem, mesh, dtype, abft=abft)
         raise ValueError(
             f"guarded sharded solves run the chunked steppers "
-            f"('xla'/'pallas'/'pipelined'/'mg-pcg'/'cheb-pcg'); got "
-            f"engine={engine!r} — the fused sharded iteration has no "
+            f"('xla'/'pallas'/'pipelined'/'sstep'/'mg-pcg'/'cheb-pcg'); "
+            f"got engine={engine!r} — the fused sharded iteration has no "
             "resumable stepper form"
         )
     if engine == "xla":
         return _ClassicalAdapter(problem, dtype, stencil="xla",
-                                 geometry=geometry, theta=theta)
+                                 geometry=geometry, theta=theta,
+                                 storage_dtype=storage_dtype)
+    if engine in ("sstep", "sstep-pallas"):
+        return _ClassicalAdapter(
+            problem, dtype,
+            stencil="xla" if engine == "sstep" else "pallas",
+            interpret=interpret, geometry=geometry, theta=theta,
+            storage_dtype=storage_dtype, sstep_s=sstep_s,
+        )
     if engine in ("mg-pcg", "cheb-pcg"):
         from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
 
+        if storage_dtype is not None:
+            # mirror build_solver's STORAGE_ENGINES stance: the mg/cheb
+            # appliers carry their own full-width level hierarchies —
+            # silently running full-width while the report says narrow
+            # would corrupt every bandwidth comparison built on it
+            raise ValueError(
+                "the preconditioner engines (mg-pcg/cheb-pcg) have no "
+                "storage-dtype form; drop --storage-dtype or use a "
+                "diagonal-preconditioned loop engine"
+            )
         return _ClassicalAdapter(
             problem, dtype, stencil="xla",
             precond_kind=PRECOND_KIND_BY_ENGINE[engine],
@@ -810,17 +1022,17 @@ def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
     if engine == "pallas":
         return _ClassicalAdapter(
             problem, dtype, stencil="pallas", interpret=interpret,
-            geometry=geometry, theta=theta,
+            geometry=geometry, theta=theta, storage_dtype=storage_dtype,
         )
     if engine == "pipelined":
         return _PipelinedAdapter(
             problem, dtype, stencil="xla", interpret=interpret,
-            geometry=geometry, theta=theta,
+            geometry=geometry, theta=theta, storage_dtype=storage_dtype,
         )
     if engine == "pipelined-pallas":
         return _PipelinedAdapter(
             problem, dtype, stencil="pallas", interpret=interpret,
-            geometry=geometry, theta=theta,
+            geometry=geometry, theta=theta, storage_dtype=storage_dtype,
         )
     if engine in ("batched", "batched-pipelined"):
         raise ValueError(
@@ -851,6 +1063,8 @@ def guarded_solve(
     geometry=None,
     theta=None,
     validate_geometry: bool = True,
+    storage_dtype=None,
+    sstep_s: int = 4,
 ) -> GuardedResult:
     """Solve with failure detection and the recovery ladder (module
     docstring). Loop engines (xla / pallas / pipelined / pipelined-pallas
@@ -879,6 +1093,14 @@ def guarded_solve(
     exhaustion (``DivergedError``), memory exhaustion with no engine
     left (``OutOfMemoryError``), or deadline (``SolveTimeout``). A
     non-finite carry is never returned as a converged result.
+
+    ``storage_dtype`` ("bf16"/"f16") runs the bandwidth-saving narrow-
+    storage loop (``ops.precision``) UNDER the guard — the product path
+    for mixed precision: the escalation ladder grows the bf16→f32 rung
+    (storage *promotion*), and every narrow solve is promoted to full
+    compute width before the guard will accept its convergence, so the
+    returned result meets the same final true-residual gate as a full-
+    width run. ``sstep_s`` sizes the s-step engines' blocks.
     """
     if chunk < 1:
         raise ValueError("chunk must be >= 1")
@@ -907,6 +1129,14 @@ def guarded_solve(
                 f"engines ({engine!r}) are validated by the final "
                 "health check alone"
             )
+        if storage_dtype is not None:
+            raise ValueError(
+                "guarded narrow-storage solves run the chunked loop "
+                "engines (xla/pallas/pipelined*/sstep*) — the whole-"
+                "solve VMEM engines have no chunk boundary to promote "
+                "at; run build_solver(storage_dtype=…) directly for "
+                "their operand-narrow forms"
+            )
         return _guarded_whole_solve(
             problem, engine, dtype, interpret=interpret, chunk=chunk,
             max_recoveries=max_recoveries, timeout=timeout, t0=t0,
@@ -914,7 +1144,8 @@ def guarded_solve(
         )
 
     adapter = _make_adapter(problem, engine, dtype, mesh, interpret,
-                            abft=abft, geometry=geometry, theta=theta)
+                            abft=abft, geometry=geometry, theta=theta,
+                            storage_dtype=storage_dtype, sstep_s=sstep_s)
     return _run_chunked(
         problem, adapter, chunk=chunk, max_recoveries=max_recoveries,
         timeout=timeout, t0=t0, plan=plan, events=events,
@@ -1038,6 +1269,45 @@ def _run_chunked(problem, adapter, *, chunk, max_recoveries, timeout, t0,
             sdc_strikes += 1
             stag_strikes = 0
             continue
+
+        storage = getattr(adapter, "storage_dtype", None)
+        if storage is not None and not word & _UNHEALTHY:
+            # A narrow-storage solve never finishes narrow. Promote to
+            # full compute width when (a) the narrow loop claims
+            # convergence — the claim is re-earned at full width before
+            # the drift gate ever sees it — or (b) a full chunk's
+            # progress collapsed (step norm no longer halving): the
+            # storage floor, where further narrow iterations are
+            # quantisation noise. Promotion is the DESIGNED finish of
+            # every narrow solve, not a failure: it does not spend the
+            # recovery budget (and it is bounded — the promoted adapter
+            # has no storage dtype to promote again).
+            _zb, diff_before = adapter.scalars(state)
+            _za, diff_after = adapter.scalars(new)
+            db, da = float(diff_before), float(diff_after)
+            full_chunk = (limit - k) >= chunk
+            at_floor = (
+                full_chunk and da == da and db == db
+                and db != float("inf") and da >= 0.5 * db
+            )
+            if word & HEALTH_CONVERGED or at_floor:
+                adapter2, convert = adapter.promote()
+                _record(
+                    events, "storage-promotion", int(new[adapter.K]), word,
+                    adapter2.engine,
+                    detail=f"{jnp.dtype(storage).name} storage -> "
+                    f"{jnp.dtype(adapter.dtype).name} compute ("
+                    + ("converged at storage width"
+                       if word & HEALTH_CONVERGED else "storage floor")
+                    + "); polishing at full width",
+                )
+                state = prev = adapter2.recover(convert(new))
+                k = int(new[adapter.K])
+                adapter = adapter2
+                consecutive = 0
+                stag_strikes = 0
+                sdc_strikes = 0
+                continue
 
         if word & HEALTH_CONVERGED and not word & _UNHEALTHY:
             drift = _residual_drift(adapter, new)

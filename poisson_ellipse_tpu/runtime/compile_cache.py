@@ -153,6 +153,12 @@ class _Entry:
     dtype: str
     norm: str
     compile_s: float
+    # HBM storage width of the lane fields ("" = storage == compute).
+    # A storage component in the cache key is load-bearing: a bf16-
+    # storage executable and a full-width one trace DIFFERENT programs
+    # for the same shapes, and serving one for the other would silently
+    # change the accuracy contract of every request in the bucket.
+    storage: str = ""
 
 
 @dataclass
@@ -169,25 +175,34 @@ class WarmPool:
 
     @staticmethod
     def key(engine: str, grid: tuple[int, int], dtype, lanes: int,
-            norm: str = "weighted"):
+            norm: str = "weighted", storage_dtype=None):
+        # the storage-dtype component ("" when storage == compute): a
+        # narrow-storage executable is a DIFFERENT traced program with a
+        # different accuracy contract — it must never be served for a
+        # full-width request (or vice versa)
+        from poisson_ellipse_tpu.ops.precision import resolve_storage_dtype
+
+        st = resolve_storage_dtype(storage_dtype, dtype)
+        storage = "" if st is None else jnp.dtype(st).name
         return (
             engine, grid_bucket(*grid), jnp.dtype(dtype).name,
-            lane_bucket(lanes), norm,
+            lane_bucket(lanes), norm, storage,
         )
 
     def warmup(self, engine: str, grid: tuple[int, int], dtype=jnp.float32,
-               lanes: int = 1, norm: str = "weighted") -> _Entry:
-        """The bucket executable for (engine, grid, dtype, lanes, norm),
-        AOT-compiling on miss — the pool's single (and deliberate)
-        ``lower().compile()`` site.
+               lanes: int = 1, norm: str = "weighted",
+               storage_dtype=None) -> _Entry:
+        """The bucket executable for (engine, grid, dtype, lanes, norm,
+        storage), AOT-compiling on miss — the pool's single (and
+        deliberate) ``lower().compile()`` site.
 
         Emits ``cache:hit``/``cache:miss`` and bumps the obs counters;
         a hit returns the *same executable object* as the miss that
         created it (asserted in tests — the no-recompile contract).
         """
-        key = self.key(engine, grid, dtype, lanes, norm)
+        key = self.key(engine, grid, dtype, lanes, norm, storage_dtype)
         entry = self.entries.get(key)
-        _, bucket, dtype_name, lb, _ = key
+        _, bucket, dtype_name, lb, _, storage = key
         if entry is not None:
             self.hits += 1
             obs_metrics.counter("compile_cache_hits").inc()
@@ -199,7 +214,8 @@ class WarmPool:
         self.misses += 1
         obs_metrics.counter("compile_cache_misses").inc()
         t0 = time.perf_counter()
-        compiled = _compile_bucket(engine, bucket, dtype, lb, norm)
+        compiled = _compile_bucket(engine, bucket, dtype, lb, norm,
+                                   storage_dtype=storage_dtype)
         compile_s = time.perf_counter() - t0
         obs_trace.event(
             "cache:miss", engine=engine, bucket=list(bucket), lanes=lb,
@@ -208,6 +224,7 @@ class WarmPool:
         entry = _Entry(
             compiled=compiled, engine=engine, bucket=bucket, lanes=lb,
             dtype=dtype_name, norm=norm, compile_s=compile_s,
+            storage=storage,
         )
         self.entries[key] = entry
         return entry
@@ -241,7 +258,7 @@ class WarmPool:
 
 
 def _compile_bucket(engine: str, bucket: tuple[int, int], dtype, lanes: int,
-                    norm: str):
+                    norm: str, storage_dtype=None):
     """AOT-compile one bucket-generic batched solver.
 
     The traced function takes every size-dependent number (h1, h2, δ,
@@ -260,14 +277,24 @@ def _compile_bucket(engine: str, bucket: tuple[int, int], dtype, lanes: int,
         raise ValueError(
             f"the warm pool serves the batched engines; got {engine!r}"
         )
+    if storage_dtype is not None and engine != "batched":
+        raise ValueError(
+            "narrow-storage bucket executables cover the 'batched' "
+            f"engine; got {engine!r}"
+        )
     Mb, Nb = bucket
     proto = Problem(M=Mb, N=Nb, norm=norm)
 
     def run(a, b, rhs, mask, h1, h2, delta, limit):
-        state = mod.init_state(proto, a, b, rhs, mask=mask, h1=h1, h2=h2)
+        kw = (
+            {"storage_dtype": storage_dtype}
+            if storage_dtype is not None else {}
+        )
+        state = mod.init_state(proto, a, b, rhs, mask=mask, h1=h1, h2=h2,
+                               **kw)
         state = mod.advance(
             proto, a, b, rhs, state, limit=limit, mask=mask, h1=h1, h2=h2,
-            delta=delta,
+            delta=delta, **kw,
         )
         return tuple(mod.result_of(state))
 

@@ -35,6 +35,19 @@ best path by default:
   cheb-pcg     classical loop, z = degree-k  ~k× fewer (the cheap first
                Chebyshev polynomial in D⁻¹A  iters     rung; bounds from
                                                        obs.spectrum)
+  sstep        s-step (communication-        ~1.0x     (s∈{2,4} iters per
+               avoiding) recurrence:                   matrix-powers round;
+               matrix-powers basis + Gram              sharded: 1 psum +
+               in ONE stacked reduction                one s-deep halo per
+               per s iterations                        s iters — the mesh-
+                                                       latency frontier)
+  sstep-       the same blocks driving the   ~1.0x     (storage_dtype= runs
+  pallas       Pallas stencil chain                    the mixed kernels)
+
+Every STORAGE_ENGINES member additionally takes ``storage_dtype=`` —
+bf16 state/operand storage with f32 compute (``ops.precision``), the
+HBM-bandwidth lever; accuracy is recovered through the guard's
+bf16→f32→f64 escalation ladder (``resilience.guard``), not assumed.
 
 Policy (``select_engine``): resident if the whole working set fits VMEM;
 else streamed if the state fits; else xl. f64 always takes xla — the
@@ -75,7 +88,26 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 ENGINES = (
     "auto", "xla", "fused", "resident", "streamed", "xl", "pallas",
     "pipelined", "pipelined-pallas", "batched", "batched-pipelined",
-    "mg-pcg", "cheb-pcg",
+    "mg-pcg", "cheb-pcg", "sstep", "sstep-pallas",
+)
+
+# the s-step (communication-avoiding) engines: s iterations per
+# matrix-powers round, ONE stacked reduction (and, sharded, ONE psum +
+# one s-deep halo) per s iterations — ops.sstep_pcg /
+# parallel.sstep_sharded. "auto" never picks them (opt-in, like the
+# preconditioner engines): their payoff is collective latency and HBM
+# passes at mesh/bandwidth-bound scale, not small-grid wall clock.
+SSTEP_ENGINES = ("sstep", "sstep-pallas")
+
+# engines that accept the storage-vs-compute split (ops.precision):
+# state and/or streamed operands at bf16 width in HBM, f32 compute.
+# The loop engines narrow everything; streamed/xl narrow their operand
+# streams (their state is VMEM-resident / kept full-width); batched
+# narrows the lane fields. The guard's escalation ladder (bf16→f32→f64)
+# is the product path for accuracy recovery (resilience.guard).
+STORAGE_ENGINES = (
+    "xla", "pallas", "pipelined", "pipelined-pallas",
+    "sstep", "sstep-pallas", "streamed", "xl", "batched",
 )
 
 # the preconditioner engines (mg.*): the classical fused loop with the
@@ -134,7 +166,7 @@ def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
 def build_solver(
     problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
     history: bool = False, lanes: int = 1, geometry=None, theta=None,
-    validate_geometry: bool = True,
+    validate_geometry: bool = True, storage_dtype=None, sstep_s: int = 4,
 ):
     """(jitted solver, args, resolved_engine) for a single-chip solve.
 
@@ -182,6 +214,17 @@ def build_solver(
             "needs the lane-batched engines ('batched' / "
             "'batched-pipelined')"
         )
+    if storage_dtype is not None:
+        from poisson_ellipse_tpu.ops.precision import resolve_storage_dtype
+
+        # resolve early: a bad name or a widening request fails here,
+        # and storage == compute normalises to None (the identity path)
+        storage_dtype = resolve_storage_dtype(storage_dtype, dtype)
+    if storage_dtype is not None and engine not in STORAGE_ENGINES:
+        raise ValueError(
+            f"engine {engine!r} has no storage-dtype form; choose from "
+            f"{', '.join(STORAGE_ENGINES)} (or drop --storage-dtype)"
+        )
     if geometry is not None:
         from poisson_ellipse_tpu.geom import sdf as geom_sdf
         from poisson_ellipse_tpu.geom import validate as geom_validate
@@ -209,16 +252,17 @@ def build_solver(
             pcg_batched_pipelined,
         )
 
-        run = (
-            pcg_batched if engine == "batched" else pcg_batched_pipelined
-        )
+        if engine == "batched":
+            run = lambda a, b, rhs: pcg_batched(
+                problem, a, b, rhs, storage_dtype=storage_dtype
+            )
+        else:
+            run = lambda a, b, rhs: pcg_batched_pipelined(problem, a, b, rhs)
         args = batched_operands(problem, lanes, dtype, geometry=geometry,
                                 theta=theta)
         # no donation: the build-once-call-many contract re-feeds these
         # operands on every dispatch (the timing protocols re-dispatch)
-        solver = jax.jit(  # tpulint: disable=TPU004
-            lambda a, b, rhs: run(problem, a, b, rhs)
-        )
+        solver = jax.jit(run)  # tpulint: disable=TPU004
         return solver, args, engine
     if engine == "auto" and history:
         # the mega-kernel engines auto would pick cannot record: take the
@@ -279,7 +323,7 @@ def build_solver(
 
         solver, args = build_streamed_solver(
             problem, dtype, interpret=interpret, geometry=geometry,
-            theta=theta,
+            theta=theta, storage_dtype=storage_dtype,
         )
     elif engine == "fused":
         from poisson_ellipse_tpu.ops.fused_pcg import build_fused_solver
@@ -293,7 +337,7 @@ def build_solver(
 
         solver, args = build_xl_solver(
             problem, dtype, interpret=interpret, geometry=geometry,
-            theta=theta,
+            theta=theta, storage_dtype=storage_dtype,
         )
     elif engine in PRECOND_ENGINES:
         # the multigrid / Chebyshev preconditioned classical loop: the
@@ -317,7 +361,28 @@ def build_solver(
         solver = jax.jit(  # tpulint: disable=TPU004
             lambda a, b, rhs: pcg_pipelined(
                 problem, a, b, rhs, stencil=stencil, interpret=interpret,
-                history=history,
+                history=history, storage_dtype=storage_dtype,
+            )
+        )
+        args = (a, b, rhs)
+    elif engine in SSTEP_ENGINES:
+        from poisson_ellipse_tpu.ops.sstep_pcg import pcg_sstep
+
+        import jax
+
+        if history:
+            raise ValueError(
+                "the s-step engines advance in coordinate blocks and do "
+                "not record the per-iteration obs.convergence buffers; "
+                "use a HISTORY_ENGINES engine for history=True"
+            )
+        a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
+                                      theta=theta)
+        stencil = "pallas" if engine == "sstep-pallas" else "xla"
+        solver = jax.jit(  # tpulint: disable=TPU004
+            lambda a, b, rhs: pcg_sstep(
+                problem, a, b, rhs, s=sstep_s, stencil=stencil,
+                interpret=interpret, storage_dtype=storage_dtype,
             )
         )
         args = (a, b, rhs)
@@ -333,7 +398,8 @@ def build_solver(
         # operands on every dispatch (bench --repeat, chained solves)
         solver = jax.jit(  # tpulint: disable=TPU004
             lambda a, b, rhs: pcg(
-                problem, a, b, rhs, stencil=stencil, history=history
+                problem, a, b, rhs, stencil=stencil, history=history,
+                storage_dtype=storage_dtype,
             )
         )
         args = (a, b, rhs)
@@ -345,7 +411,7 @@ def build_solver(
 def solve(
     problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
     history: bool = False, lanes: int = 1, geometry=None, theta=None,
-    validate_geometry: bool = True,
+    validate_geometry: bool = True, storage_dtype=None, sstep_s: int = 4,
 ):
     """Assemble and solve single-chip with the selected engine.
 
@@ -359,6 +425,7 @@ def solve(
     solver, args, _ = build_solver(
         problem, engine, dtype, interpret=interpret, history=history,
         lanes=lanes, geometry=geometry, theta=theta,
-        validate_geometry=validate_geometry,
+        validate_geometry=validate_geometry, storage_dtype=storage_dtype,
+        sstep_s=sstep_s,
     )
     return solver(*args)

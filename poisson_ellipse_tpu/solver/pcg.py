@@ -37,6 +37,11 @@ from poisson_ellipse_tpu.obs.convergence import (
     trace_of,
 )
 from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.ops.precision import (
+    load as _load,
+    resolve_storage_dtype,
+    store as _store,
+)
 from poisson_ellipse_tpu.ops.reduction import grid_dot, grid_dots
 from poisson_ellipse_tpu.ops.stencil import apply_a, apply_dinv, diag_d
 
@@ -55,7 +60,7 @@ class PCGResult(NamedTuple):
 
 
 def init_state(problem: Problem, a, b, rhs, history: bool = False,
-               precond=None):
+               precond=None, storage_dtype=None):
     """The PCG carry at iteration 0 (the resumable solver state).
 
     Layout: (k, w, r, p, zr, diff, converged, breakdown) — everything the
@@ -67,8 +72,14 @@ def init_state(problem: Problem, a, b, rhs, history: bool = False,
     ``precond`` is the optional ``z = M⁻¹ r`` applier (a linear SPD
     operator — the multigrid V-cycle / Chebyshev appliers of ``mg``);
     None keeps the reference's diagonal preconditioner exactly.
+
+    ``storage_dtype`` (``ops.precision``) stores the carry's vector
+    fields (w, r, p) at that width — bf16 halves their HBM footprint —
+    while the scalar recurrence (zr, diff) stays at compute width; None
+    is byte-identical to the pre-storage-axis carry.
     """
     dtype = rhs.dtype
+    st = resolve_storage_dtype(storage_dtype, dtype)
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     d = diag_d(a, b, h1, h2)
@@ -77,9 +88,9 @@ def init_state(problem: Problem, a, b, rhs, history: bool = False,
     zr0 = grid_dot(z0, r0, h1, h2)
     state = (
         jnp.asarray(0, jnp.int32),
-        jnp.zeros_like(rhs),
-        r0,
-        z0,  # p0 = z0
+        jnp.zeros_like(rhs, dtype=st or rhs.dtype),
+        _store(r0, st),
+        _store(z0, st),  # p0 = z0
         zr0,
         jnp.asarray(jnp.inf, dtype),
         jnp.asarray(False),
@@ -91,7 +102,7 @@ def init_state(problem: Problem, a, b, rhs, history: bool = False,
 
 
 def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla",
-            history: bool = False, precond=None):
+            history: bool = False, precond=None, storage_dtype=None):
     """Advance the PCG carry until convergence/breakdown or iteration
     ``limit`` (defaults to max_iterations). Returns the new carry.
 
@@ -108,8 +119,16 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
     ``precond`` swaps the diagonal preconditioner for an arbitrary
     linear SPD ``z = M⁻¹ r`` applier (``mg``'s V-cycle / Chebyshev);
     None traces exactly the historical diagonal loop.
+
+    ``storage_dtype`` runs the storage-vs-compute split of
+    ``ops.precision``: the carry's vectors AND the streamed operands
+    (a, b, D) live at storage width in HBM, every read upcasts to the
+    compute dtype in the consumer (XLA fuses the convert — the HBM read
+    stays storage-width), every store rounds back down. None traces the
+    byte-identical full-width loop.
     """
     dtype = rhs.dtype
+    st = resolve_storage_dtype(storage_dtype, dtype)
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     delta = jnp.asarray(problem.delta, dtype)
@@ -124,18 +143,49 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
     )
     weighted = problem.norm == "weighted"
 
-    if stencil == "pallas":
-        from poisson_ellipse_tpu.ops.pallas_kernels import apply_a_pallas
+    if st is not None and precond is not None:
+        raise ValueError(
+            "storage_dtype covers the diagonal-preconditioned loops; the "
+            "mg/cheb appliers carry their own full-width level hierarchy "
+            "— run them at compute width"
+        )
+    d = diag_d(a, b, h1, h2)
+    if st is not None:
+        # operands stream at storage width too (the byte cut covers every
+        # HBM pass, not just the carry); rounded ONCE here, upcast inside
+        # the body so the loads stay narrow
+        a_s, b_s, d_s = _store(a, st), _store(b, st), _store(d, st)
+    else:
+        a_s, b_s, d_s = a, b, d
 
-        apply_stencil = lambda p: apply_a_pallas(p, a, b, problem.h1, problem.h2)
+    if stencil == "pallas":
+        if st is not None:
+            from poisson_ellipse_tpu.ops.pallas_kernels import (
+                apply_a_mixed_pallas,
+            )
+
+            # the explicit mixed kernel: storage-width tiles DMA'd to
+            # VMEM, upcast there, f32 stencil arithmetic, compute-width out
+            apply_stencil = lambda p: apply_a_mixed_pallas(
+                p, a_s, b_s, problem.h1, problem.h2, compute_dtype=dtype
+            )
+        else:
+            from poisson_ellipse_tpu.ops.pallas_kernels import apply_a_pallas
+
+            apply_stencil = lambda p: apply_a_pallas(
+                p, a, b, problem.h1, problem.h2
+            )
     elif stencil == "xla":
-        apply_stencil = lambda p: apply_a(p, a, b, h1, h2)
+        apply_stencil = lambda p: apply_a(
+            _load(p, dtype, st), _load(a_s, dtype, st),
+            _load(b_s, dtype, st), h1, h2,
+        )
     else:
         raise ValueError(f"unknown stencil: {stencil!r}")
 
-    d = diag_d(a, b, h1, h2)
     apply_precond = (
-        (lambda r: apply_dinv(r, d)) if precond is None else precond
+        (lambda r: apply_dinv(r, _load(d_s, dtype, st)))
+        if precond is None else precond
     )
 
     def cond(state):
@@ -143,8 +193,13 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
         return (k < max_iter) & ~converged & ~breakdown
 
     def body(state):
-        k, w, r, p, zr, _diff, _c, _bd = state[:8]
-        ap = apply_stencil(p)
+        k, w_s, r_s, p_s, zr, _diff, _c, _bd = state[:8]
+        # tile-local upcast to compute width (fused into the consumers —
+        # the HBM reads stay storage-width); identity when st is None
+        w = _load(w_s, dtype, st)
+        r = _load(r_s, dtype, st)
+        p = _load(p_s, dtype, st)
+        ap = apply_stencil(p_s)
         denom = grid_dot(ap, p, h1, h2)
         breakdown = denom < DENOM_GUARD
         alpha = zr / jnp.where(breakdown, 1.0, denom)
@@ -175,9 +230,10 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
 
         # On breakdown the reference exits *before* touching w/r (stage0:128);
         # keep the pre-update iterates in that (rare, terminal) case.
-        w_out = jnp.where(breakdown, w, w_new)
-        r_out = jnp.where(breakdown, r, r_new)
-        p_out = jnp.where(breakdown | converged, p, p_new)
+        # Stores round back to storage width (identity when st is None).
+        w_out = jnp.where(breakdown, w_s, _store(w_new, st))
+        r_out = jnp.where(breakdown, r_s, _store(r_new, st))
+        p_out = jnp.where(breakdown | converged, p_s, _store(p_new, st))
         zr_out = jnp.where(breakdown | converged, zr, zr_new)
         out = (k + 1, w_out, r_out, p_out, zr_out, diff, converged, breakdown)
         if history:
@@ -205,7 +261,7 @@ def result_of(state) -> PCGResult:
 
 
 def pcg(problem: Problem, a, b, rhs, stencil: str = "xla",
-        history: bool = False, precond=None):
+        history: bool = False, precond=None, storage_dtype=None):
     """Run PCG for pre-assembled coefficients. All inputs (M+1, N+1).
 
     Jit-safe with ``problem`` static; the while_loop carries
@@ -223,11 +279,20 @@ def pcg(problem: Problem, a, b, rhs, stencil: str = "xla",
     precond: optional ``z = M⁻¹ r`` applier replacing the diagonal
     preconditioner (see ``advance``; ``mg`` builds the V-cycle and
     Chebyshev appliers this hook exists for).
+
+    storage_dtype: the HBM storage width of the carry vectors and
+    streamed operands (``ops.precision``; "bf16" halves the loop's HBM
+    bytes, compute stays at ``rhs.dtype``). None = storage == compute,
+    byte-identical to the historical loop. The product path for bf16 is
+    the guard (``resilience.guard``), whose ladder recovers full-width
+    accuracy; the raw engine converges to the storage dtype's floor.
     """
     state = advance(
         problem, a, b, rhs,
-        init_state(problem, a, b, rhs, history=history, precond=precond),
+        init_state(problem, a, b, rhs, history=history, precond=precond,
+                   storage_dtype=storage_dtype),
         stencil=stencil, history=history, precond=precond,
+        storage_dtype=storage_dtype,
     )
     result = result_of(state)
     if history:
@@ -236,7 +301,8 @@ def pcg(problem: Problem, a, b, rhs, stencil: str = "xla",
 
 
 def solve(problem: Problem, dtype=jnp.float32, stencil: str = "xla",
-          history: bool = False):
+          history: bool = False, storage_dtype=None):
     """Assemble and solve on a single chip (the stage0-shaped entry point)."""
     a, b, rhs = assembly.assemble(problem, dtype)
-    return pcg(problem, a, b, rhs, stencil=stencil, history=history)
+    return pcg(problem, a, b, rhs, stencil=stencil, history=history,
+               storage_dtype=storage_dtype)

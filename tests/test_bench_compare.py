@@ -462,3 +462,52 @@ def test_grad_only_in_one_round_is_noted_not_failed():
     regs, notes = bc.compare(old, new, TOL)
     assert not regs
     assert any("grad" in n for n in notes)
+
+
+# ------------------------------------------------ the bandwidth key's gates
+
+
+def _bw(t_pipe_bf16=0.6, gbps_bf16=400.0, ratio=0.5, parity=True):
+    return {
+        "available": True,
+        "grid": [2400, 3200],
+        "byte_ratio_gate": 0.6,
+        "l2_band": 1.10,
+        "cells": [
+            {"engine": "pipelined", "storage": "f32", "t_solver_s": 1.0,
+             "hbm_gbps": 300.0, "l2_err": 1e-4},
+            {"engine": "pipelined", "storage": "bf16",
+             "t_solver_s": t_pipe_bf16, "hbm_gbps": gbps_bf16,
+             "l2_err": 1.05e-4, "byte_ratio_vs_f32": ratio,
+             "l2_parity": parity},
+        ],
+        "ok": True,
+    }
+
+
+def test_bandwidth_identical_rounds_pass_and_absence_is_noted():
+    old = make_round(bandwidth=_bw())
+    assert regressions_between(old, old) == []
+    regs, notes = bc.compare(make_round(), make_round(bandwidth=_bw()), TOL)
+    assert not [r for r in regs if "bandwidth_t" in r.metric]
+    assert any("bandwidth" in n for n in notes)
+
+
+def test_bandwidth_cell_slowdown_and_gbps_drop_are_regressions():
+    old = make_round(bandwidth=_bw())
+    slow = make_round(bandwidth=_bw(t_pipe_bf16=0.9))
+    regs = regressions_between(old, slow)
+    assert ("bandwidth_t_solver_s", "bandwidth pipelined/bf16") in regs
+    dropped = make_round(bandwidth=_bw(gbps_bf16=200.0))
+    regs = regressions_between(old, dropped)
+    assert ("bandwidth_hbm_gbps", "bandwidth pipelined/bf16") in regs
+
+
+def test_bandwidth_hard_pins_fire_on_the_new_round_alone():
+    old = make_round(bandwidth=_bw())
+    fat = make_round(bandwidth=_bw(ratio=0.75))
+    regs = regressions_between(old, fat)
+    assert ("bandwidth_byte_ratio", "bandwidth pipelined/bf16") in regs
+    off = make_round(bandwidth=_bw(parity=False))
+    regs = regressions_between(old, off)
+    assert ("bandwidth_l2_parity", "bandwidth pipelined/bf16") in regs

@@ -396,3 +396,164 @@ def test_meshguard_abft_sdc_reloads_checkpoint(mesh22, clean, tmp_path):
     assert [e.kind for e in r.events] == ["sdc-rollback"]
     assert bool(r.result.converged)
     assert int(r.result.iters) == int(clean.iters)
+
+
+# ----------------- the s-step sharded cells + the bf16 drift alarm
+
+
+SSTEP_FAULT_AT = 12  # a block boundary (s=4): faults land exactly
+
+
+@pytest.fixture(scope="module")
+def sstep_adapter(mesh22):
+    from poisson_ellipse_tpu.resilience.guard import _make_adapter
+
+    return _make_adapter(
+        PROBLEM, "sstep", jnp.float64, mesh22, None, abft=True
+    )
+
+
+def _run_sstep(adapter, plan=None, max_recoveries=3):
+    import time
+
+    from poisson_ellipse_tpu.resilience.guard import _run_chunked
+
+    return _run_chunked(
+        PROBLEM, adapter, chunk=SSTEP_FAULT_AT,
+        max_recoveries=max_recoveries, timeout=None, t0=time.monotonic(),
+        plan=plan if plan is not None else FaultPlan(), events=[],
+    )
+
+
+@pytest.fixture(scope="module")
+def sstep_clean(sstep_adapter):
+    g = _run_sstep(sstep_adapter)
+    assert not g.recoveries, g.recoveries  # ABFT silent on health
+    assert bool(g.result.converged)
+    assert abs(int(g.result.iters) - ORACLE) <= 2
+    return g
+
+
+@pytest.mark.parametrize("fault", [
+    "halo_bitflip_p", "halo_bitflip_r", "nan", "breakdown", "psum_corrupt",
+])
+def test_sstep_sdc_matrix_recovers_or_classifies(
+    sstep_adapter, sstep_clean, fault
+):
+    """{nan, breakdown, halo_bitflip, psum_corrupt} × sstep: every cell
+    recovers to oracle-iteration parity (detected via the block-level
+    shadow recurrences → sdc-rollback; NaN/breakdown via the health
+    word → restart) or is structurally absorbed — psum_corrupt lands on
+    the carried zr scalar, which the s-step block RE-DERIVES from the
+    Gram diagonal, so that corruption cannot touch the iterate at all
+    (absorbed at exact parity, zero events — the re-derivation defense;
+    Gram-diagonal positivity still catches a sign-flipped reduction
+    inside the block). The r-flip rides the detection model: its
+    single-element drift sits against the dtype-scaled rtol, so it is
+    flagged or numerically absorbed — either way the final result must
+    converge at clean accuracy, never a silent wrong answer."""
+    from poisson_ellipse_tpu.resilience import force_breakdown
+
+    at = SSTEP_FAULT_AT
+    plan = {
+        "halo_bitflip_p": lambda: FaultPlan(halo_bitflip(at, field="p")),
+        "halo_bitflip_r": lambda: FaultPlan(halo_bitflip(at, field="r")),
+        "psum_corrupt": lambda: FaultPlan(psum_corrupt(at)),
+        "nan": lambda: FaultPlan(inject_nan(at, "r")),
+        "breakdown": lambda: FaultPlan(force_breakdown(at)),
+    }[fault]()
+    guarded = _run_sstep(sstep_adapter, plan)
+    kinds = {e.kind for e in guarded.recoveries}
+    assert kinds <= SDC_EVENTS, kinds
+    if fault == "halo_bitflip_p":
+        assert "sdc-rollback" in kinds  # the shadow Σp prediction fired
+    if fault in ("nan", "breakdown"):
+        assert "residual-restart" in kinds
+    if fault == "psum_corrupt":
+        assert not kinds  # structurally absorbed by re-derivation
+    assert bool(guarded.result.converged)
+    assert abs(
+        int(guarded.result.iters) - int(sstep_clean.result.iters)
+    ) <= 2 + (4 if fault == "halo_bitflip_r" else 0)
+    l2 = float(l2_error_vs_analytic(PROBLEM, guarded.result.w))
+    l2_clean = float(l2_error_vs_analytic(PROBLEM, sstep_clean.result.w))
+    assert l2 <= l2_clean * 1.01 + 1e-12
+
+
+def test_sstep_persistent_corruption_raises_classified_sdc(sstep_adapter):
+    with pytest.raises(SilentCorruptionError) as exc:
+        _run_sstep(
+            sstep_adapter,
+            FaultPlan(halo_bitflip(
+                SSTEP_FAULT_AT, field="p", persistent=True
+            )),
+        )
+    assert exc.value.exit_code == 6
+
+
+def test_abft_drift_alarm_is_dtype_scaled():
+    """The low-precision drift alarm (the PR 9 shadow recurrences with
+    the dtype-scaled rtol): the SAME injected perturbation that the f32
+    path FLAGS (its drift clears the f32 band) is numerically absorbed
+    by the bf16-storage path — whose band sits above its own storage-
+    rounding noise, so the bf16 run reaches its floor with NO false
+    alarm — while a storage-scale corruption (a top-exponent flip, far
+    above bf16's band) still fires even there. One alarm, three
+    regimes, all keyed on ``ops.precision.effective_dtype``."""
+    from poisson_ellipse_tpu.ops.precision import effective_dtype
+    from poisson_ellipse_tpu.parallel.sstep_sharded import (
+        build_sstep_sharded_stepper,
+    )
+    from poisson_ellipse_tpu.resilience.abft import abft_rtol
+
+    # the rtol scaling fact itself
+    assert abft_rtol(jnp.bfloat16) > abft_rtol(jnp.float32) > abft_rtol(
+        jnp.float64
+    )
+    assert effective_dtype(jnp.float32, "bf16") == jnp.dtype(jnp.bfloat16)
+    mesh = _mesh(2, 1, 2)
+    fields = {"w": 1, "r": 2, "p": 3, "zr": 4}
+
+    def run_cell(storage, bit):
+        init, adv = build_sstep_sharded_stepper(
+            PROBLEM, mesh, jnp.float32, s=4, abft=True,
+            storage_dtype=storage,
+        )
+        st = adv(init(), 16)
+        plan = FaultPlan(halo_bitflip(16, field="p", bit=bit))
+        st = plan.apply(16, st, fields, 7, 4)
+        return adv(st, PROBLEM.max_iterations)
+
+    # f32 path: the default-magnitude flip clears the f32 band → flagged
+    out = run_cell(None, None)
+    assert bool(out[11])
+    # bf16-storage path: the SAME flip sits inside the bf16 band (which
+    # must tolerate bf16 storage rounding) → absorbed; the run reaches
+    # its floor with no alarm and a finite iterate
+    out = run_cell("bf16", None)
+    assert not bool(out[11])
+    assert bool(jnp.all(jnp.isfinite(out[1].astype(jnp.float32))))
+    # ... and the absorbed flip is ABSORBED, not laundered: the run
+    # still reaches the storage floor (the detection model's honest
+    # boundary — below the band, CG's own self-correction plus the
+    # replacement discipline wash the perturbation out, and the guard's
+    # final true-residual gate validates whatever is returned)
+    assert float(out[5]) < 1e-3
+
+
+def test_sstep_healthy_bf16_storage_no_false_alarm():
+    """bf16 storage + ABFT, healthy: the tightened replacement cadence
+    and the restart-aware Σp check keep the alarm silent all the way to
+    the storage floor (the false-fire this test pins against was
+    measured and fixed during development)."""
+    from poisson_ellipse_tpu.parallel.sstep_sharded import (
+        build_sstep_sharded_stepper,
+    )
+
+    mesh = _mesh(2, 1, 2)
+    init, adv = build_sstep_sharded_stepper(
+        PROBLEM, mesh, jnp.float32, s=4, abft=True, storage_dtype="bf16"
+    )
+    out = adv(init(), PROBLEM.max_iterations)
+    assert not bool(out[11])
+    assert float(out[5]) < 1e-3  # reached the storage floor

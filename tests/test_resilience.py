@@ -426,3 +426,108 @@ def test_cli_timeout_rejects_native_mode(capsys):
     )
     assert rc == 2
     assert "native" in capsys.readouterr().err
+
+
+# ------------------- the new axes: s-step cells + bf16-storage cells
+
+
+@pytest.mark.parametrize("fault", ("nan", "breakdown"))
+def test_sstep_fault_matrix_recovers_to_parity(fault):
+    """sstep × {nan, breakdown}: the classical carry layout means the
+    classical recover applies verbatim — one residual restart, parity
+    within ±2 of the clean s-step run."""
+    clean = clean_result("sstep")
+    guarded = guarded_solve(
+        PROBLEM, "sstep", jnp.float32, chunk=CHUNK,
+        faults=FaultPlan(FAULTS[fault]()),
+    )
+    kinds = [event.kind for event in guarded.recoveries]
+    assert kinds == ["residual-restart"], (fault, kinds)
+    assert_parity(guarded, clean, "sstep")
+
+
+def test_sstep_fallback_hands_carry_to_pipelined_then_classical():
+    """The sstep fallback ladder, walked adapter by adapter: the
+    mid-solve classical-layout carry hands over to the PIPELINED
+    recurrence through a ground-truth rebuild (x and the direction p
+    carry across), and the pipelined adapter's own fallback continues
+    to classical — each rung reconverging to the clean answer."""
+    sstep_ad = _ClassicalAdapter(PROBLEM, jnp.float32, sstep_s=4)
+    assert sstep_ad.engine == "sstep"
+    mid = sstep_ad.advance(sstep_ad.init(), 12)
+    # rung 1: sstep → pipelined, carry handoff
+    pipe_ad, convert = sstep_ad.fallback()
+    assert pipe_ad.engine == "pipelined"
+    pipe_state = pipe_ad.recover(convert(mid))
+    done = pipe_ad.advance(pipe_state, PROBLEM.max_iterations)
+    res = pipe_ad.result(done)
+    assert bool(res.converged)
+    clean = clean_result("xla")
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(clean.w), rtol=0, atol=5e-5
+    )
+    # rung 2: pipelined → classical exists (the pre-existing ladder)
+    cl_ad, _ = pipe_ad.fallback()
+    assert cl_ad.engine == "xla"
+
+
+def test_sstep_persistent_fault_exhausts_ladder_classified():
+    """A persistent NaN re-fires down every rung (sstep → pipelined →
+    classical → f64): the contracted outcome is the classified
+    DivergedError (exit 2), never a NaN dressed as converged."""
+    plan = FaultPlan(
+        Fault("nan", at_iter=FAULT_AT, field="r", persistent=True)
+    )
+    with pytest.raises(DivergedError) as exc:
+        guarded_solve(
+            PROBLEM, "sstep", jnp.float32, chunk=CHUNK, faults=plan,
+            max_recoveries=6,
+        )
+    assert exc.value.exit_code == 2
+
+
+@pytest.mark.parametrize("fault", ("nan", "breakdown"))
+def test_bf16_storage_fault_cells_recover_through_promotion(fault):
+    """bf16-storage × {nan, breakdown}: the fault fires inside the
+    narrow phase; the ladder (restart → storage promotion) still ends
+    at a full-width converged result at f32-level analytic accuracy."""
+    from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
+
+    ref = clean_result("xla")
+    ref_l2 = float(l2_error_vs_analytic(PROBLEM, ref.w))
+    guarded = guarded_solve(
+        PROBLEM, "xla", jnp.float32, chunk=CHUNK,
+        storage_dtype="bf16", faults=FaultPlan(FAULTS[fault]()),
+        max_recoveries=5,
+    )
+    assert bool(guarded.result.converged)
+    got = float(l2_error_vs_analytic(
+        PROBLEM, guarded.result.w.astype(jnp.float32)
+    ))
+    assert got <= 1.05 * ref_l2, (fault, got, ref_l2)
+    kinds = [event.kind for event in guarded.recoveries]
+    assert "storage-promotion" in kinds or "precision-escalation" in kinds
+
+
+def test_bf16_storage_false_convergence_is_promoted_not_returned():
+    """The raw bf16 classical loop 'converges' at the storage floor
+    (diff < δ on quantised steps) with a true residual orders above an
+    f32 run's — the guard must never return that carry as-is: the
+    promotion rung re-earns convergence at full width first."""
+    guarded = guarded_solve(
+        PROBLEM, "xla", jnp.float32, chunk=64, storage_dtype="bf16"
+    )
+    assert bool(guarded.result.converged)
+    # the finishing adapter runs at full width (dtype reported f32)
+    assert guarded.dtype == "float32"
+    a, b, rhs = __import__(
+        "poisson_ellipse_tpu.ops.assembly", fromlist=["assemble"]
+    ).assemble(PROBLEM, jnp.float32)
+    from poisson_ellipse_tpu.ops.stencil import apply_a
+
+    h1 = jnp.asarray(PROBLEM.h1, jnp.float32)
+    h2 = jnp.asarray(PROBLEM.h2, jnp.float32)
+    w = guarded.result.w.astype(jnp.float32)
+    resid = float(jnp.linalg.norm(rhs - apply_a(w, a, b, h1, h2)))
+    rhsn = float(jnp.linalg.norm(rhs))
+    assert resid / rhsn < 1e-2  # the drift gate's bar, met at full width

@@ -27,10 +27,10 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_seventeen_rules():
+def test_registry_has_all_eighteen_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
         "TPU010", "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
-        "TPU016", "TPU017",
+        "TPU016", "TPU017", "TPU018",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -1870,5 +1870,96 @@ def test_tpu017_suppression_comment():
     src = """
         import jax
         g = jax.grad(lambda x: pcg(x).w)(1.0)  # tpulint: disable=TPU017
+    """
+    assert codes_of(src) == []
+
+
+# -- TPU018: silent downcast into a reduction --------------------------------
+
+
+def test_tpu018_positive_astype_and_narrow_arithmetic():
+    src = """
+        import jax.numpy as jnp
+
+        def f(x, y):
+            xb = x.astype(jnp.bfloat16)
+            a = jnp.sum(xb)
+            b = jnp.sum(xb * y.astype(jnp.bfloat16))
+            c = jnp.dot(x.astype("bfloat16"), x.astype("bf16"))
+            return a, b, c
+    """
+    assert codes_of(src) == ["TPU018", "TPU018", "TPU018"]
+
+
+def test_tpu018_positive_name_propagation_and_half():
+    src = """
+        import jax.numpy as jnp
+
+        def f(x):
+            xb = x.astype(jnp.float16)
+            scaled = xb * 2.0
+            return jnp.einsum("i,i->", scaled, scaled)
+    """
+    assert codes_of(src) == ["TPU018"]
+
+
+def test_tpu018_negative_wide_accumulator_routes():
+    src = """
+        import jax.numpy as jnp
+
+        def upcast_first(x):
+            xb = x.astype(jnp.bfloat16)
+            return jnp.sum(xb.astype(jnp.float32))
+
+        def wide_dtype_kwarg(x):
+            return jnp.sum(x.astype("bf16"), dtype=jnp.float32)
+
+        def mixed_fn_route(x):
+            xb = x.astype(jnp.bfloat16)
+            return apply_a_dots_mixed_pallas(xb)
+
+        def rebound_wide(x):
+            xb = x.astype(jnp.bfloat16)
+            xb = xb.astype(jnp.float32)
+            return jnp.sum(xb)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu018_negative_opaque_and_wide_mixed():
+    src = """
+        import jax.numpy as jnp
+
+        def opaque_dtype(x, dt):
+            return jnp.sum(x.astype(dt))
+
+        def promotes_wide(x, y):
+            # bf16 * f32 promotes to f32 — not a narrow accumulation
+            return jnp.sum(x.astype(jnp.bfloat16) * y)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu018_config_knob_and_reduction_roots():
+    src = """
+        import jax.numpy as jnp
+
+        def f(x):
+            xb = x.astype(jnp.bfloat16)
+            return my_reducer(xb)
+    """
+    # the project's own reduction wrapper, seen through reduction_roots
+    assert codes_of(src, reduction_roots=("my_reducer",)) == ["TPU018"]
+    # ... unless it is a sanctioned mixed accumulator
+    assert codes_of(
+        src, reduction_roots=("my_reducer",),
+        mixed_accum_fns=("my_reducer",),
+    ) == []
+
+
+def test_tpu018_suppression_comment():
+    src = """
+        import jax.numpy as jnp
+        s = jnp.sum(x.astype(jnp.bfloat16))  # tpulint: disable=TPU018
     """
     assert codes_of(src) == []

@@ -537,3 +537,34 @@ def test_grad_field_absent_or_failed_is_supported(workspace):
     assert "Differentiable solving" not in text
     assert "Adjoint-vs-primal iterations" in text
     assert "560/546" in text
+
+
+def test_bandwidth_table_rendered_when_present(workspace):
+    rec = make_artifact(bandwidth={
+        "available": True,
+        "grid": [2400, 3200],
+        "byte_ratio_gate": 0.6,
+        "cells": [
+            {"engine": "sstep", "storage": "f32", "t_solver_s": 1.2,
+             "hbm_gbps": 310.0, "l2_err": 9.9e-5},
+            {"engine": "sstep", "storage": "bf16", "t_solver_s": 0.7,
+             "hbm_gbps": 520.0, "l2_err": 1.01e-4,
+             "byte_ratio_vs_f32": 0.5, "l2_parity": True},
+        ],
+        "ok": True,
+    })
+    lines = urb.bandwidth_lines(rec)
+    text = "\n".join(lines)
+    assert "Memory-bandwidth frontier at 2400×3200" in text
+    assert "| sstep | bf16 | 0.7 s | 520 |" in text
+    assert "0.50×" in text
+
+
+def test_bandwidth_absent_or_failed_is_supported(workspace):
+    assert urb.bandwidth_lines(make_artifact()) == []
+    assert urb.bandwidth_lines(
+        make_artifact(bandwidth={"available": False, "error": "x"})
+    ) == []
+    assert urb.bandwidth_lines(
+        make_artifact(bandwidth={"available": True, "cells": []})
+    ) == []

@@ -83,6 +83,10 @@ DEFAULT_TOLERANCES = {
     # serving noise floor; the adjoint/primal iteration ratio gets the
     # same band (same-operator adjoints must keep tracking the primal)
     "grad-pct": 0.25,
+    # bandwidth key ({f32, bf16-storage} × {pipelined, sstep} cells):
+    # per-cell T_solver/GB/s share the wall-clock noise floor; the
+    # ≤0.6× byte ratio and the l2 parity flag are hard pins per round
+    "bandwidth-pct": 0.25,
 }
 
 # scalar-row artifact keys carrying {grid, t_solver_s, iters}
@@ -466,6 +470,61 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
                 ))
     elif (o_grad is None) != (n_grad is None):
         notes.append("grad: only in one round, skipped")
+
+    # the bandwidth key: per-cell T_solver/GB/s drift between rounds
+    # under `bandwidth-pct`, plus two hard pins carried by the new
+    # round itself — the ≤0.6× modeled byte ratio and the bf16 l2
+    # parity flag — which are acceptance facts, not noise-band numbers
+    def bw_cells(rec):
+        row = rec.get("bandwidth")
+        if not isinstance(row, dict) or not row.get("available"):
+            return {}
+        return {
+            (c.get("engine"), c.get("storage")): c
+            for c in row.get("cells") or []
+        }
+
+    o_bw, n_bw = bw_cells(old), bw_cells(new)
+    for key in sorted(o_bw.keys() & n_bw.keys()):
+        where_bw = f"bandwidth {key[0]}/{key[1]}"
+        o_t = o_bw[key].get("t_solver_s")
+        n_t = n_bw[key].get("t_solver_s")
+        if not one_sided("bandwidth t_solver_s", where_bw, o_t, n_t) and \
+                o_t and n_t is not None:
+            limit = tol["bandwidth-pct"]
+            if n_t > o_t * (1.0 + limit):
+                regressions.append(Regression(
+                    "bandwidth_t_solver_s", where_bw, o_t, n_t,
+                    f"+{(n_t / o_t - 1):.0%} > +{limit:.0%}",
+                ))
+        o_g = o_bw[key].get("hbm_gbps")
+        n_g = n_bw[key].get("hbm_gbps")
+        if not one_sided("bandwidth hbm_gbps", where_bw, o_g, n_g) and \
+                o_g and n_g is not None:
+            limit = tol["bandwidth-pct"]
+            if n_g < o_g * (1.0 - limit):
+                regressions.append(Regression(
+                    "bandwidth_hbm_gbps", where_bw, o_g, n_g,
+                    f"{(n_g / o_g - 1):.0%} > {limit:.0%} bandwidth drop",
+                ))
+    if n_bw:
+        for key, cell in sorted(n_bw.items()):
+            ratio = cell.get("byte_ratio_vs_f32")
+            gate = new.get("bandwidth", {}).get("byte_ratio_gate", 0.6)
+            if ratio is not None and ratio > gate:
+                regressions.append(Regression(
+                    "bandwidth_byte_ratio",
+                    f"bandwidth {key[0]}/{key[1]}", gate, ratio,
+                    f"modeled byte ratio {ratio:.2f}x > {gate:g}x gate",
+                ))
+            if cell.get("l2_parity") is False:
+                regressions.append(Regression(
+                    "bandwidth_l2_parity",
+                    f"bandwidth {key[0]}/{key[1]}", 1, 0,
+                    "bf16 l2 left the f32 parity band",
+                ))
+    if bool(o_bw) != bool(n_bw):
+        notes.append("bandwidth: only in one round, skipped")
 
     return regressions, notes
 

@@ -213,7 +213,47 @@ def table_block(rec: dict, src: str) -> str:
     grad = grad_lines(rec)
     if grad:
         lines += [""] + grad
+    bandwidth = bandwidth_lines(rec)
+    if bandwidth:
+        lines += [""] + bandwidth
     return "\n".join(lines)
+
+
+def bandwidth_lines(rec: dict) -> list[str]:
+    """Markdown for the artifact's ``bandwidth`` key ({f32, bf16-
+    storage} × {pipelined, sstep} at the HBM-bound grid, emitted since
+    the precision/s-step axes landed). Pre-bandwidth artifacts lack the
+    key and render without the table; a failed study
+    (``available: false``) or empty cell list renders nothing — absence
+    and failure are supported inputs, not errors."""
+    bw = rec.get("bandwidth")
+    if not isinstance(bw, dict) or not bw.get("available"):
+        return []
+    cells = [c for c in (bw.get("cells") or []) if c.get("t_solver_s")]
+    if not cells:
+        return []
+    g = bw.get("grid", ["?", "?"])
+    lines = [
+        f"Memory-bandwidth frontier at {g[0]}×{g[1]} (bf16 storage / "
+        "f32 compute + s-step CG; the bf16 cells run the guard's "
+        "storage-promotion ladder, so their l2 is recovered at full "
+        "width — regression-gated by `tools/bench_compare.py` "
+        "`bandwidth-pct` with the ≤0.6× byte ratio and l2 parity as "
+        "hard pins):",
+        "",
+        "| engine | storage | T_solver | GB/s | l2 err | bytes/iter vs f32 |",
+        "|---|---|---|---|---|---|",
+    ]
+    for c in cells:
+        ratio = c.get("byte_ratio_vs_f32")
+        lines.append(
+            f"| {c.get('engine', '?')} | {c.get('storage', '?')} | "
+            f"{c['t_solver_s']:g} s | {c.get('hbm_gbps', 0):g} | "
+            f"{c.get('l2_err', float('nan')):.3e} | "
+            + (f"{ratio:.2f}×" if ratio is not None else "—")
+            + " |"
+        )
+    return lines
 
 
 def grad_lines(rec: dict) -> list[str]:
