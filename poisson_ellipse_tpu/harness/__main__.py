@@ -39,6 +39,13 @@ The serving surface:
   arbitrary request sizes hit a warm executable —
   ``python -m poisson_ellipse_tpu.harness warmup --grids 400x600
   --lanes 1,8 --engine both``.
+- ``tune`` is the autotuner subcommand (``runtime.autotune``): probe
+  the shape's telemetry, score every candidate engine configuration,
+  print the chosen config vs the static default with predicted-vs-
+  measured columns, and (``--persist``) write the winner next to the
+  XLA compile cache for ``--engine auto`` and the serve warm pool to
+  consult — ``python -m poisson_ellipse_tpu.harness tune --grid
+  400x600 --measure --persist``.
 - ``serve`` drives a synthetic request stream through the
   continuous-batching scheduler (``serve.scheduler``): seeded Poisson
   arrivals of mixed shapes, bounded admission with backpressure,
@@ -325,8 +332,11 @@ def _run_inject(argv: list[str]) -> int:
     )
     ap.add_argument(
         "--engine", default="xla",
-        choices=("xla", "pallas", "pipelined", "pipelined-pallas"),
-        help="chunk-steppable engine to guard (carry faults need one)",
+        choices=("xla", "pallas", "pipelined", "pipelined-pallas",
+                 "mg-pcg", "cheb-pcg", "fmg"),
+        help="chunk-steppable engine to guard (carry faults need one); "
+        "the multigrid engines walk the mg->cheb->diag fallback ladder, "
+        "and fmg chunk-steps its verification handoff loop",
     )
     ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
     ap.add_argument("--chunk", type=int, default=16)
@@ -616,6 +626,164 @@ def _run_diagnose(argv: list[str]) -> int:
             return 2
         return 0 if record["converged"] else 1
     finally:
+        if args.trace:
+            obs_trace.stop()
+
+
+def _run_tune(argv: list[str]) -> int:
+    """The ``tune`` subcommand: the closed-loop autotuner for one shape.
+
+    Runs ``runtime.autotune`` end to end — telemetry probe (κ and
+    Ritz-predicted iterations via ``obs.spectrum``, measured GB/s via
+    ``obs.profile``), candidate scoring, winner selection with the
+    static default as the anchor it must beat — and prints the chosen
+    config against the static default with predicted-vs-measured
+    columns. ``--persist`` writes the winner into the registry next to
+    the XLA compile cache, where ``build_solver(engine="auto")`` and
+    the serve warm pool consult it at admission.
+    """
+    ap = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.harness tune",
+        description="Telemetry-driven autotuning for one shape: score "
+        "engine configurations from measured telemetry (obs.spectrum "
+        "Ritz-predicted iterations, obs.profile GB/s), pick a winner "
+        "that provably does not lose to the static default, and "
+        "optionally persist it next to the XLA compile cache for "
+        "engine='auto' and the serve warm pool to consult.",
+    )
+    ap.add_argument("--grid", help="MxN grid to tune (default 40x40)")
+    ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument(
+        "--storage-dtype", choices=("bf16", "f16", "f32"), default=None,
+        help="tune the narrow-storage key (separate registry entry: a "
+        "narrow executable is a different accuracy contract)",
+    )
+    ap.add_argument("--delta", type=float, default=1e-6)
+    ap.add_argument(
+        "--geometry", metavar="SPEC",
+        help="tune for an SDF domain (JSON spec file or inline JSON); "
+        "the key carries the geometry fingerprint",
+    )
+    ap.add_argument(
+        "--measure", action="store_true",
+        help="wall-clock the winner against the static default and "
+        "demote a loser before persisting (the measured half of the "
+        "never-loses contract; predictions alone decide otherwise)",
+    )
+    ap.add_argument(
+        "--persist", action="store_true",
+        help="write the winner into the tuned-config registry "
+        "(autotune.json next to the XLA compile cache)",
+    )
+    ap.add_argument(
+        "--registry", metavar="FILE", default=None,
+        help="registry path override (default: next to the XLA cache)",
+    )
+    ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
+    ap.add_argument("--json", action="store_true", help="one JSON line")
+    args = ap.parse_args(argv)
+
+    from poisson_ellipse_tpu.runtime import autotune
+
+    if args.trace:
+        obs_trace.start(args.trace)
+    try:
+        try:
+            grid = _parse_grid(args.grid)
+            problem = Problem(M=grid[0], N=grid[1], delta=args.delta)
+            jdtype = resolve_dtype(args.dtype)
+            geometry = _geometry_spec(args.geometry)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"error: cannot read --geometry: {e}", file=sys.stderr)
+            return 2
+        except SolveError as e:
+            print(f"error: {e.classification}: {e}", file=sys.stderr)
+            return e.exit_code
+        try:
+            registry = (
+                autotune.TuneRegistry(args.registry).load()
+                if args.registry else None
+            )
+            report = autotune.tune(
+                problem, jdtype, storage_dtype=args.storage_dtype,
+                geometry=geometry, registry=registry, persist=args.persist,
+                measure=args.measure,
+            )
+        except SolveError as e:
+            # classified failures inside the loop itself (geometry
+            # assembly, telemetry probe, measurement solves) exit with
+            # the same curated contract as `harness run`
+            print(f"error: {e.classification}: {e}", file=sys.stderr)
+            return e.exit_code
+        if args.json:
+            print(json.dumps(report))
+            return 0
+        chosen = report["chosen"]
+        tel = report["telemetry"]
+        print(
+            f"tune {grid[0]}x{grid[1]} ({args.dtype}"
+            + (f", storage {args.storage_dtype}" if args.storage_dtype
+               else "")
+            + f"): key {report['key']}"
+        )
+        kappa = tel.get("kappa")
+        print(
+            "telemetry: kappa "
+            + (f"{kappa:.6g}" if kappa is not None else "n/a")
+            + f", Ritz-predicted diag iters {tel.get('predicted_iters')}"
+            + (f", measured {tel['gbps']:.0f} GB/s" if tel.get("gbps")
+               else "")
+        )
+        print(
+            "  candidate            knobs                         "
+            "pred iters   pred T(s)    meas T(s)"
+        )
+        for row in report["candidates"]:
+            # the chosen knobs carry the serve chunk on top of the
+            # candidate's own — subset match identifies the winner row
+            marker = "->" if (
+                row["engine"] == chosen["engine"]
+                and all(chosen["knobs"].get(k) == v
+                        for k, v in row["knobs"].items())
+            ) else "  "
+            measured = ""
+            if row["engine"] == chosen["engine"] and chosen.get(
+                    "measured_t_s") is not None:
+                measured = f"{chosen['measured_t_s']:12.5f}"
+            elif row["engine"] == chosen.get("static_engine") and chosen.get(
+                    "static_measured_t_s") is not None:
+                measured = f"{chosen['static_measured_t_s']:12.5f}"
+            knobs = ",".join(f"{k}={v}" for k, v in row["knobs"].items())
+            print(
+                f"{marker} {row['engine']:18s} {knobs:28s} "
+                f"{row['predicted_iters']:10.1f} "
+                f"{row['predicted_t_s']:11.6f} {measured}"
+            )
+        static = chosen.get("static_engine")
+        if chosen["engine"] == static:
+            print(
+                f"chosen: the static default ({static}) stands"
+                + ("; predicted winner DEMOTED after measurement"
+                   if report["demoted_to_static"] else "")
+            )
+        else:
+            print(
+                f"chosen: {chosen['engine']} over static default "
+                f"{static}"
+                + (" (measured winner)" if chosen.get("measured_t_s")
+                   is not None else " (predicted winner)")
+                + ("; DEMOTED to static after measurement"
+                   if report["demoted_to_static"] else "")
+            )
+        if report.get("registry_path"):
+            print(f"persisted: {report['registry_path']}")
+        return 0
+    finally:
+        obs_metrics.REGISTRY.emit()
+        obs_metrics.REGISTRY.reset()
         if args.trace:
             obs_trace.stop()
 
@@ -1274,6 +1442,8 @@ def main(argv=None) -> int:
         return _run_inject(argv[1:])
     if argv and argv[0] == "warmup":
         return _run_warmup(argv[1:])
+    if argv and argv[0] == "tune":
+        return _run_tune(argv[1:])
     if argv and argv[0] == "diagnose":
         return _run_diagnose(argv[1:])
     if argv and argv[0] == "serve":
@@ -1312,12 +1482,16 @@ def main(argv=None) -> int:
         "batched-pipelined run --lanes independent solves per dispatch "
         "(the throughput engines, per-lane results); sstep/sstep-pallas "
         "run the s-step communication-avoiding recurrence (--sstep-s "
-        "iterations per matrix-powers round). Sharded "
+        "iterations per matrix-powers round); fmg runs ONE full-"
+        "multigrid F-cycle (O(N) work, constant per grid point) plus "
+        "the verified mg-pcg handoff against delta. Sharded "
         "mode: xla (default), pallas (the per-shard stencil kernel), "
         "fused (the two-kernel per-shard iteration, f32/bf16), "
         "pipelined (one stacked psum per iteration), sstep (ONE psum + "
-        "one s-deep halo per s iterations), or batched/"
-        "batched-pipelined with --lanes sharded over the mesh",
+        "one s-deep halo per s iterations), fmg (per-level halo "
+        "discipline, classical psum cadence in the handoff), mg-pcg/"
+        "cheb-pcg, or batched/batched-pipelined with --lanes sharded "
+        "over the mesh",
     )
     ap.add_argument(
         "--threads",

@@ -86,7 +86,10 @@ def _row(engine: str, M: int, N: int, oracle: int) -> tuple[bool, str]:
             # solution (ROADMAP item 1): converged, strictly fewer
             # iterations than the diagonal oracle, and l2-vs-analytic
             # no worse than +10% of the diagonal solve — the rule the
-            # bench `precond` key enforces at the published grids
+            # bench `precond` key enforces at the published grids.
+            # (fmg never reaches this matrix: run_acceptance filters
+            # it out below — its gates live in tests/test_fmg, the
+            # graft-entry smoke check and the bench `fmg` key.)
             from poisson_ellipse_tpu.utils.error import (
                 l2_error_vs_analytic,
             )
@@ -143,7 +146,13 @@ def run_acceptance(headline: bool = False, out=sys.stderr) -> bool:
     print(f"backend: {jax.default_backend()}  devices: {jax.devices()}",
           file=out)
     all_ok = True
-    engines = [e for e in ENGINES if e != "auto"]
+    # fmg is gated elsewhere, not by the oracle matrix: its iteration
+    # count is the verification-handoff count (not an oracle fact), and
+    # each row would pay a Lanczos probe + F-cycle build per grid —
+    # tests/test_fmg pins its l2 parity, __graft_entry__'s fmg smoke
+    # check drives it through the real CLI, and the bench `fmg` key
+    # gates it on the chip
+    engines = [e for e in ENGINES if e not in ("auto", "fmg")]
     for (M, N), oracle in SMALL_ORACLES.items():
         for engine in engines:
             ok, note = _row(engine, M, N, oracle)

@@ -81,12 +81,17 @@ def passes_per_iter(problem: Problem, engine: str, dtype=jnp.float32,
     """
     if engine in ("xla", "pallas"):
         return 13.0
-    if engine in ("mg-pcg", "cheb-pcg"):
+    if engine in ("mg-pcg", "cheb-pcg", "fmg"):
         # the classical loop's 13 plus the preconditioner's modeled
         # extra traffic (V-cycle levels geometrically discounted /
         # Chebyshev degree; mg.engine.modeled_extra_passes). More
         # bytes per iteration, ~order-of-magnitude fewer iterations —
-        # the trade the bench "precond" key measures end to end.
+        # the trade the bench "precond" key measures end to end. fmg's
+        # reported iterations are its verification-handoff iterations
+        # (the same V-cycle-preconditioned loop), so the per-iteration
+        # figure is mg-pcg's; the F-cycle prelude's fixed O(N) bytes
+        # are the work-unit model's column (mg.fmg.work_units_per_point),
+        # not a per-iteration quantity.
         from poisson_ellipse_tpu.mg.engine import modeled_extra_passes
 
         return 13.0 + modeled_extra_passes(problem, engine, dtype)

@@ -28,14 +28,20 @@ from poisson_ellipse_tpu.resilience.errors import (
     OutOfMemoryError,
     is_oom_error,
 )
-from poisson_ellipse_tpu.solver.engine import BATCHED_ENGINES, build_solver
+from poisson_ellipse_tpu.solver.engine import (
+    BATCHED_ENGINES,
+    CAPACITY_LADDER,
+    build_solver,
+)
 from poisson_ellipse_tpu.utils.error import l2_error_vs_analytic
 from poisson_ellipse_tpu.utils.timing import PhaseTimer, fence
 
 # runtime degradation ladder for `--engine auto`: RESOURCE_EXHAUSTED on
 # the first (compile + warm-up) dispatch walks down one rung per retry;
-# xla has no capacity gate, so the ladder always terminates
-_DEGRADE_LADDER = ("resident", "streamed", "xl", "xla")
+# xla has no capacity gate, so the ladder always terminates. The rungs
+# are the engine-capability table's (solver.engine.ENGINE_CAPS) — one
+# source for the ladder here, in build_solver and in the autotuner.
+_DEGRADE_LADDER = CAPACITY_LADDER
 # seconds before re-dispatching after an OOM: gives the allocator a beat
 # to release the failed attempt's buffers before the smaller engine asks
 _DEGRADE_BACKOFF_S = 0.25
@@ -481,6 +487,18 @@ def run_once(
                 problem, mesh, jdtype,
                 kind=PRECOND_KIND_BY_ENGINE[engine],
                 geometry=geometry, theta=theta,
+            )
+            fence(args)
+        shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])
+    elif mode == "sharded" and engine == "fmg":
+        from poisson_ellipse_tpu.parallel.mg_sharded import (
+            build_fmg_sharded_solver,
+        )
+
+        with timer.phase("init"):
+            mesh = resolve_mesh(mesh_shape)
+            solver, args = build_fmg_sharded_solver(
+                problem, mesh, jdtype, geometry=geometry, theta=theta,
             )
             fence(args)
         shape = (mesh.shape[AXIS_X], mesh.shape[AXIS_Y])

@@ -8,7 +8,7 @@ caught by hand across five rewrites. tpulint catches them mechanically:
     python -m poisson_ellipse_tpu.lint              # paths from pyproject
     python -m poisson_ellipse_tpu.lint poisson_ellipse_tpu/ops --statistics
 
-Rules are TPU001–TPU016 (see :mod:`.rules`); any finding can be waived
+Rules are TPU001–TPU019 (see :mod:`.rules`); any finding can be waived
 in place with a trailing or preceding-line comment::
 
     x = jnp.zeros(n, jnp.float64)  # tpulint: disable=TPU001
@@ -168,6 +168,9 @@ def load_config(root: Optional[str] = None) -> LintConfig:
         ),
         mixed_accum_fns=tuple(
             table.get("mixed-accum-fns", cfg.mixed_accum_fns)
+        ),
+        tunable_fns=tuple(
+            table.get("tunable-fns", cfg.tunable_fns)
         ),
     )
 

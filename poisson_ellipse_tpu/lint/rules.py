@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU018.
+"""The tpulint rule registry: TPU001–TPU019.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -79,6 +79,14 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | linearly in n; upcast first, pass a wide      |
 |        |                    | `dtype=`, or route via `mixed-accum-fns` (the |
 |        |                    | storage-vs-compute fence of `ops.precision`)  |
+| TPU019 | hardcoded-tunable  | a bare numeric literal bound to a tunable     |
+|        |                    | knob keyword (Chebyshev degree, MG depth/ν,   |
+|        |                    | s-step s, chunk size) at a solver-builder     |
+|        |                    | call site (`tunable-fns`) — the autotuner     |
+|        |                    | (`runtime.autotune`) can neither see nor      |
+|        |                    | overrule it; route the value through the      |
+|        |                    | engine-capability table, a named constant, or |
+|        |                    | the tuned-config registry                     |
 """
 
 from __future__ import annotations
@@ -168,6 +176,16 @@ class LintConfig:
     # designed route, not a silent downcast.
     mixed_accum_fns: tuple[str, ...] = (
         "*_mixed_pallas", "*.precision.load", "*.precision.store",
+    )
+    # TPU019: solver-builder callables (leaf-name/qualname fnmatch
+    # patterns) whose tunable-knob keyword arguments must come from the
+    # autotune registry / engine-capability table / named constants —
+    # a bare numeric literal at one of these call sites is a hardcoded
+    # tunable the autotuner can never see or overrule.
+    tunable_fns: tuple[str, ...] = (
+        "build_solver", "build_*_solver", "build_*_stepper",
+        "make_precond", "make_vcycle", "make_fcycle", "guarded_solve",
+        "solve_batched", "pcg_sstep", "resolve_fmg_config",
     )
 
 
@@ -2597,4 +2615,100 @@ def check_silent_downcast(module: Module,
                 "HBM read stays narrow), pass `dtype=jnp.float32` to "
                 "the reduction, or route through a `mixed-accum-fns` "
                 "helper (ops.precision / the mixed Pallas kernels)",
+            )
+
+
+# --------------------------------------------------------------------------
+# TPU019 — numeric literals hardcoding tunable solver knobs at call sites
+# --------------------------------------------------------------------------
+
+# the knob vocabulary: keyword names that select engine configurations
+# the autotuner owns (solver.engine.ENGINE_CAPS tunables + the serve
+# chunk axis). A literal bound to one of these at a builder call site
+# freezes a choice the closed loop exists to make.
+_TUNABLE_KWARGS = frozenset({
+    "cheb_degree", "coarse_degree", "nu", "levels", "n_vcycles",
+    "sstep_s", "chunk", "degree",
+})
+
+# enclosing-function shapes where a knob literal IS the registry: the
+# static defaults the tuner scores against (default_*/resolve_*_config
+# constructors) and the tuner's own candidate sweeps (tune*/candidates)
+_TUNABLE_EXEMPT_FNS = ("default_*", "resolve_*_config", "tune*",
+                       "candidates", "*_config")
+
+
+def _enclosing_fn_name(module: Module, node: ast.AST) -> str:
+    """Name of the innermost enclosing function definition, or ''
+    (the visitor's parent links; lambdas are anonymous, keep walking)."""
+    for anc in module.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc.name
+    return ""
+
+
+@rule(
+    "TPU019",
+    "hardcoded-tunable",
+    "a bare numeric literal bound to a tunable knob keyword at a "
+    "solver-builder call site — the autotune registry can neither see "
+    "nor overrule it",
+)
+def check_hardcoded_tunable(module: Module,
+                            config: LintConfig) -> Iterator[Finding]:
+    """The autotuning fence (``runtime.autotune``). The engine zoo's
+    knobs — Chebyshev degree, MG depth/ν/coarse degree, F-cycle
+    correction count, s-step block size, serve chunk — are selected per
+    shape by the closed-loop tuner and recorded once in the
+    engine-capability table (``solver.engine.ENGINE_CAPS``). A numeric
+    literal bound to one of those keywords at a builder call site
+    (``tunable-fns``) silently pins the choice where neither the table
+    nor the registry can reach it: the tuned config loads, the literal
+    wins, and the regression gate blames the wrong layer.
+
+    Compliant routes: a named constant (module UPPERCASE or a config
+    dataclass field), the capability table's ``tunables`` row, or a
+    value threaded from the tuned-config registry. Exemptions keep the
+    registry definable at all: the autotune module itself, and
+    default-config constructors / tuner candidate sweeps
+    (``default_*`` / ``resolve_*_config`` / ``tune*`` / ``candidates``)
+    — the one place a static default's literal must live.
+    """
+    norm_path = module.path.replace(os.sep, "/")
+    if norm_path.endswith("runtime/autotune.py"):
+        return  # the registry itself: candidate sweeps ARE literals
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _matches_fn(module, node.func, config.tunable_fns):
+            continue
+        hits = [
+            kw for kw in node.keywords
+            if kw.arg in _TUNABLE_KWARGS
+            and isinstance(kw.value, ast.Constant)
+            and isinstance(kw.value.value, (int, float))
+            and not isinstance(kw.value.value, bool)
+        ]
+        if not hits:
+            continue
+        enclosing = _enclosing_fn_name(module, node)
+        if any(fnmatch.fnmatch(enclosing, pat)
+               for pat in _TUNABLE_EXEMPT_FNS):
+            continue
+        leaf = (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else getattr(node.func, "id", "<call>")
+        )
+        for kw in hits:
+            yield _finding(
+                module,
+                kw.value,
+                "TPU019",
+                f"`{leaf}(... {kw.arg}={kw.value.value!r})` hardcodes a "
+                "tunable knob at a builder call site — the autotuner "
+                "(runtime.autotune) and the engine-capability table "
+                "(solver.engine.ENGINE_CAPS) can neither see nor "
+                "overrule it. Route the value through a named "
+                "constant, the table's tunables row, or the tuned-"
+                "config registry",
             )

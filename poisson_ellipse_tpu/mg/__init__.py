@@ -24,6 +24,12 @@ from poisson_ellipse_tpu.mg.engine import (
     make_precond,
     modeled_extra_passes,
 )
+from poisson_ellipse_tpu.mg.fmg import (
+    FMGConfig,
+    build_fmg_solver,
+    make_fcycle,
+    work_units_per_point,
+)
 from poisson_ellipse_tpu.mg.transfer import (
     prolong_bilinear,
     restrict_full_weighting,
@@ -31,16 +37,19 @@ from poisson_ellipse_tpu.mg.transfer import (
 from poisson_ellipse_tpu.mg.vcycle import LevelOps, make_vcycle
 
 __all__ = [
+    "FMGConfig",
     "GERSHGORIN_LMAX",
     "Level",
     "LevelOps",
     "PrecondConfig",
+    "build_fmg_solver",
     "build_hierarchy",
     "build_precond_solver",
     "chebyshev_apply",
     "coarsen_coefficients",
     "default_config",
     "lanczos_bounds",
+    "make_fcycle",
     "make_precond",
     "make_vcycle",
     "modeled_extra_passes",

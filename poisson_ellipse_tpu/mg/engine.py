@@ -152,9 +152,27 @@ def _level_ops(levels: list[coarsen.Level], cfg: PrecondConfig,
     return out
 
 
+def apply_overrides(cfg: PrecondConfig, overrides: dict | None,
+                    max_levels: int) -> PrecondConfig:
+    """A probed config with the autotuner's knob overrides applied —
+    only the knobs the kind owns, levels clamped to what the grid can
+    coarsen to. The spectral interval is untouched: knobs change the
+    cycle shape, the probe stays the single source of the bounds."""
+    if not overrides:
+        return cfg
+    fields = {"levels", "nu", "coarse_degree", "cheb_degree"}
+    picked = {
+        k: int(v) for k, v in overrides.items()
+        if k in fields and v is not None
+    }
+    if "levels" in picked:
+        picked["levels"] = max(1, min(picked["levels"], max_levels))
+    return dataclasses.replace(cfg, **picked) if picked else cfg
+
+
 def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
                  config: PrecondConfig | None = None, operands=None,
-                 geometry=None, theta=None):
+                 geometry=None, theta=None, overrides: dict | None = None):
     """(precond_factory, config): the engine-facing build.
 
     ``precond_factory(a, b) -> (r -> M⁻¹ r)`` is called INSIDE the
@@ -165,7 +183,10 @@ def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
     A supplied ``config`` carrying a degenerate interval (the dataclass
     default lo=0.0 — only ``resolve_config`` fills a probed one) is
     normalised through the Gershgorin fallback instead of crashing the
-    Chebyshev setup at trace time.
+    Chebyshev setup at trace time. ``overrides`` applies the autotune
+    registry's knobs (levels/ν/degrees) ON TOP of the probed config —
+    the consult path of ``build_solver(engine="auto")``, so a tuned
+    cheb_degree actually runs instead of decorating the registry.
     """
     a, b, rhs = (
         operands if operands is not None
@@ -174,6 +195,9 @@ def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
     )
     cfg = config if config is not None else resolve_config(
         problem, a, b, rhs, kind
+    )
+    cfg = apply_overrides(
+        cfg, overrides, coarsen.num_levels(problem.M, problem.N)
     )
     lo, hi = cheby.clip_interval((cfg.lo, cfg.hi))
     if (lo, hi) != (cfg.lo, cfg.hi):
@@ -205,13 +229,15 @@ def make_precond(problem: Problem, dtype=jnp.float32, kind: str = "mg",
 
 
 def build_precond_solver(problem: Problem, engine: str, dtype=jnp.float32,
-                         history: bool = False, geometry=None, theta=None):
+                         history: bool = False, geometry=None, theta=None,
+                         overrides: dict | None = None):
     """(jitted solver, args, resolved engine) — the ``solver.engine``
     branch for ``mg-pcg`` / ``cheb-pcg``. Same contract as every other
     engine: args = the assembled (a, b, rhs), one fused while_loop, the
     ``PCGResult`` (+ optional ``ConvergenceTrace``) out. ``geometry``/
     ``theta`` flow into the fine assembly AND the coarsening hierarchy
-    (``mg.coarsen``) so every level sees the same domain."""
+    (``mg.coarsen``) so every level sees the same domain; ``overrides``
+    is the autotune registry's knob dict (see ``make_precond``)."""
     from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
 
     a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
@@ -219,6 +245,7 @@ def build_precond_solver(problem: Problem, engine: str, dtype=jnp.float32,
     factory, _cfg = make_precond(
         problem, dtype, PRECOND_KIND_BY_ENGINE[engine],
         operands=(a, b, rhs), geometry=geometry, theta=theta,
+        overrides=overrides,
     )
 
     # no donation: the build-once-call-many contract re-feeds these
@@ -239,11 +266,13 @@ def modeled_extra_passes(problem: Problem, engine: str,
     pointwise D⁻¹-scaled update (~3 passes); level-l arrays are 4⁻ˡ of
     the fine array. Transfers add ~2 fine-equivalent passes per level
     pair. A model, not a measurement — same stance as the rest of the
-    roofline module."""
-    from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
+    roofline module. The preconditioner kind comes from the engine-
+    capability table, so ``fmg`` (whose handoff loop IS the V-cycle-
+    preconditioned loop) models like ``mg-pcg`` without a special case."""
+    from poisson_ellipse_tpu.solver.engine import ENGINE_CAPS
 
     per_apply = 7.0
-    cfg = default_config(problem, PRECOND_KIND_BY_ENGINE[engine])
+    cfg = default_config(problem, ENGINE_CAPS[engine]["precond_kind"])
     if cfg.kind == "cheb":
         return per_apply * max(cfg.cheb_degree - 1, 0) + 2.0
     applies = vcycle.stencil_applies_per_cycle(

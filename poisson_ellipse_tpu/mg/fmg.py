@@ -1,0 +1,277 @@
+"""Full multigrid (FMG): the O(N) F-cycle solver with a verified handoff.
+
+PR 8 made the V-cycle a *preconditioner* — the iteration count stopped
+growing with the grid, but every solve still starts from zero and pays
+O(10¹) fine-grid iterations. The F-cycle here is multigrid as the
+*solver* (Brandt's classical full-multigrid result): nested iteration
+from the coarsest level up —
+
+    f_0 = rhs;  f_{l+1} = R f_l            (restrict the RHS down)
+    x_L = Chebyshev-solve(f_L)             (coarsest: a fixed polynomial)
+    for l = L−1 … 0:
+        x_l  = P x_{l+1}                   (bilinear prolongation of the
+                                            coarse solution = the fine
+                                            initial guess)
+        x_l += ν_f V-cycles on f_l − A x_l (error correction at level l)
+
+Each level's correction costs a CONSTANT number of stencil applications
+per point of that level, and level sizes shrink geometrically (4⁻ˡ in
+2D), so the whole solve is O(N) work — constant work units per fine
+grid point (:func:`work_units_per_point`, pinned ±20% across grid sizes
+in ``tests/test_fmg.py``) — and reaches discretization-level accuracy
+(l2-vs-analytic parity with mg-pcg, PAPER.md §0) in one pass.
+
+Accuracy is VERIFIED, never assumed — the same discipline as the
+guard's false-convergence check: the F-cycle solution seeds a
+warm-started mg-pcg loop (``solver.pcg.init_state(x0=...)`` rebuilds
+the TRUE residual r = rhs − A·x0) that runs until the step-norm rule
+meets the requested δ. When the F-cycle already landed at
+discretization accuracy the handoff exits after one verification
+iteration; when it missed — a rough geometry, an adversarial RHS — the
+handoff IS mg-pcg from a very good start, converging in the few
+iterations the remaining error costs. ``PCGResult.iters`` counts the
+handoff iterations (the F-cycle's work is static and reported by the
+work-unit model, not the iteration counter).
+
+The cycle is generic over layout exactly like ``mg.vcycle``: it
+consumes the same :class:`~poisson_ellipse_tpu.mg.vcycle.LevelOps`
+closure bundles, so the single-chip form (global node grids, built
+here) and the mesh form (halo-exchanged shard blocks,
+``parallel.mg_sharded.build_fmg_sharded_solver``) share one cycle
+definition. Level count, ν and degrees are STATIC per grid bucket
+(tpulint TPU013's contract); the tunable knobs register in
+``solver.engine.ENGINE_CAPS`` and are what ``runtime.autotune`` turns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.mg import cheby, coarsen, vcycle
+from poisson_ellipse_tpu.mg.engine import (
+    PrecondConfig,
+    _level_ops,
+    resolve_config,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.ops import assembly
+from poisson_ellipse_tpu.solver.pcg import (
+    advance,
+    init_state,
+    result_of,
+)
+
+# V-cycles applied per level after prolongation: 1 is Brandt's textbook
+# F-cycle; 2 buys a safety margin against the ε-jump's interface modes
+# for one extra work unit, keeping the handoff at ~1 verification
+# iteration on the published grids. Static per grid bucket; the
+# autotuner (runtime.autotune) may select 1 where the spectrum allows.
+DEFAULT_FMG_VCYCLES = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class FMGConfig:
+    """Static F-cycle configuration for one grid bucket: the V-cycle
+    knobs of :class:`~poisson_ellipse_tpu.mg.engine.PrecondConfig` plus
+    the per-level correction count ``n_vcycles``."""
+
+    levels: int
+    nu: int = vcycle.DEFAULT_NU
+    coarse_degree: int = vcycle.DEFAULT_COARSE_DEGREE
+    n_vcycles: int = DEFAULT_FMG_VCYCLES
+    lo: float = 0.0
+    hi: float = cheby.GERSHGORIN_LMAX
+
+    def precond_config(self) -> PrecondConfig:
+        """The equivalent V-cycle preconditioner config (the handoff
+        loop's M⁻¹ and the shared ``_level_ops`` builder's input)."""
+        return PrecondConfig(
+            kind="mg", levels=self.levels, nu=self.nu,
+            coarse_degree=self.coarse_degree, lo=self.lo, hi=self.hi,
+        )
+
+
+def default_fmg_config(problem: Problem) -> FMGConfig:
+    """The per-grid-bucket static config (level count from the grid)."""
+    return FMGConfig(levels=coarsen.num_levels(problem.M, problem.N))
+
+
+def config_from_knobs(problem: Problem, knobs: dict | None,
+                      ) -> FMGConfig | None:
+    """An FMGConfig from the autotune registry's knob dict (None when
+    no knobs apply) — the consult path of ``build_solver`` and the
+    tuner's own measurement, so a tuned n_vcycles/levels actually runs.
+    Levels clamp to what the grid can coarsen to; the spectral interval
+    stays the probe's (``resolve_fmg_config`` fills it)."""
+    if not knobs:
+        return None
+    max_levels = coarsen.num_levels(problem.M, problem.N)
+    levels = int(knobs.get("levels") or max_levels)
+    return FMGConfig(
+        levels=max(1, min(levels, max_levels)),
+        nu=int(knobs.get("nu", vcycle.DEFAULT_NU)),
+        coarse_degree=int(
+            knobs.get("coarse_degree", vcycle.DEFAULT_COARSE_DEGREE)
+        ),
+        n_vcycles=int(knobs.get("n_vcycles", DEFAULT_FMG_VCYCLES)),
+    )
+
+
+def resolve_fmg_config(problem: Problem, a, b, rhs,
+                       config: FMGConfig | None = None) -> FMGConfig:
+    """``default_fmg_config`` with the Lanczos-probed spectral interval
+    filled in (the same single shared probe path as ``mg.engine``). A
+    supplied config keeps its knobs; only a degenerate interval (the
+    dataclass default lo=0.0) is re-probed."""
+    cfg = config if config is not None else default_fmg_config(problem)
+    if cfg.lo > 0.0:
+        return cfg
+    probed = resolve_config(problem, a, b, rhs, "mg")
+    return dataclasses.replace(cfg, lo=probed.lo, hi=probed.hi)
+
+
+def make_fcycle(levels: list[vcycle.LevelOps],
+                nu: int = vcycle.DEFAULT_NU,
+                coarse_degree: int = vcycle.DEFAULT_COARSE_DEGREE,
+                n_vcycles: int = DEFAULT_FMG_VCYCLES):
+    """The ``x ≈ A⁻¹ rhs`` F-cycle applier for a static level list
+    (finest first) — layout-generic like :func:`mg.vcycle.make_vcycle`.
+
+    A single level degenerates to the coarsest Chebyshev sweep (the
+    uncoarsenable-grid case, same stance as the V-cycle's). The Python
+    recursion/loops below unroll at trace time over the STATIC level
+    list — one traced computation, zero host syncs (TPU013's contract).
+    """
+    if not levels:
+        raise ValueError("need at least one level")
+    if n_vcycles < 0:
+        raise ValueError("n_vcycles must be >= 0")
+
+    def fcycle(rhs):
+        # restrict the RHS down the hierarchy (one pass, reused below)
+        fs = [rhs]
+        for ops in levels[:-1]:
+            fs.append(ops.restrict(fs[-1]))
+        last = levels[-1]
+        x = cheby.chebyshev_apply(
+            last.apply_a, last.dinv, fs[-1], last.solve_lo, last.smooth_hi,
+            coarse_degree,
+        )
+        for l in range(len(levels) - 2, -1, -1):
+            ops = levels[l]
+            x = ops.prolong(x)
+            if n_vcycles:
+                # a trace-time unroll over the STATIC level list — one
+                # V-cycle closure per level of one traced computation,
+                # not a per-call rebuild (the level count is a
+                # compile-time constant per grid bucket)
+                vc = vcycle.make_vcycle(
+                    levels[l:],  # tpulint: disable=TPU013 — static unroll
+                    nu=nu, coarse_degree=coarse_degree,
+                )
+                for _ in range(n_vcycles):
+                    x = x + vc(fs[l] - ops.apply_a(x))
+        return x
+
+    return fcycle
+
+
+def work_units_per_point(levels: int, nu: int = vcycle.DEFAULT_NU,
+                         coarse_degree: int = vcycle.DEFAULT_COARSE_DEGREE,
+                         n_vcycles: int = DEFAULT_FMG_VCYCLES) -> float:
+    """Fine-grid-equivalent stencil applications per fine grid point for
+    one F-cycle — the O(N) claim as a number.
+
+    A stencil application at level l touches 4⁻ˡ of the fine points, so
+    the geometric level sum is bounded by 4/3 of the finest level's
+    count regardless of depth: the model the constant-work-per-point pin
+    in ``tests/test_fmg.py`` holds across grid sizes (±20% — the
+    coarsest Chebyshev sweep and the tail levels contribute the slack).
+    """
+    applies = [0.0] * levels
+    # the correction V-cycles starting at each level l cost the V-cycle
+    # ladder over levels[l:]; one residual evaluation precedes each
+    for l in range(levels - 1):
+        per_level = vcycle.stencil_applies_per_cycle(
+            levels - l, nu, coarse_degree
+        )
+        for j, n in enumerate(per_level):
+            applies[l + j] += n_vcycles * n
+        applies[l] += n_vcycles  # the f_l − A x_l residual per V-cycle
+    applies[levels - 1] += coarse_degree - 1  # the coarsest direct sweep
+    return sum(n * (0.25 ** l) for l, n in enumerate(applies))
+
+
+def build_fmg_solver(problem: Problem, dtype=jnp.float32,
+                     history: bool = False, geometry=None, theta=None,
+                     config: FMGConfig | None = None):
+    """(jitted solver, args, "fmg") — the ``solver.engine`` branch.
+
+    Same contract as every other engine: args = the assembled
+    (a, b, rhs), ONE jitted computation (the F-cycle unrolls into the
+    trace, the handoff is the fused mg-pcg while_loop), a ``PCGResult``
+    out (+ ``ConvergenceTrace`` with ``history=True`` — the handoff
+    loop's iterations, recorded by the shared ``obs.convergence``
+    buffers). ``geometry``/``theta`` flow into the fine assembly AND
+    the coarsening hierarchy, exactly as for mg-pcg.
+    """
+    a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
+                                  theta=theta)
+    cfg = resolve_fmg_config(problem, a, b, rhs, config)
+    hier = coarsen.build_hierarchy(
+        problem, dtype, geometry=geometry, theta=theta
+    )[: cfg.levels]
+    pc = cfg.precond_config()
+
+    def run(a, b, rhs):
+        ops = _level_ops(hier, pc, fine_a=a, fine_b=b)
+        x0 = make_fcycle(ops, nu=cfg.nu, coarse_degree=cfg.coarse_degree,
+                         n_vcycles=cfg.n_vcycles)(rhs)
+        # the verified handoff: mg-pcg warm-started at the F-cycle
+        # solution — the loop's first iteration computes the realised
+        # step norm against δ, so convergence is measured, not assumed
+        precond = vcycle.make_vcycle(ops, nu=cfg.nu,
+                                     coarse_degree=cfg.coarse_degree)
+        state = init_state(problem, a, b, rhs, history=history,
+                           precond=precond, x0=x0)
+        state = advance(problem, a, b, rhs, state, history=history,
+                        precond=precond)
+        result = result_of(state)
+        if history:
+            from poisson_ellipse_tpu.obs.convergence import trace_of
+
+            return result, trace_of(state[8:], result.iters)
+        return result
+
+    # no donation: the build-once-call-many contract re-feeds these
+    # operands on every dispatch (the timing protocols re-dispatch)
+    solver = jax.jit(run)  # tpulint: disable=TPU004
+    return solver, (a, b, rhs), "fmg"
+
+
+def fmg_initial_guess(problem: Problem, dtype=jnp.float32, geometry=None,
+                      theta=None, config: FMGConfig | None = None):
+    """One jitted F-cycle: (x0, (a, b, rhs), cfg) — the warm-start
+    prelude the guard threads through ``_ClassicalAdapter(x0=...)`` so
+    a guarded fmg run chunk-steps the handoff loop (health word,
+    residual restart, the mg→cheb→diag ladder) from the F-cycle seed."""
+    a, b, rhs = assembly.assemble(problem, dtype, geometry=geometry,
+                                  theta=theta)
+    cfg = resolve_fmg_config(problem, a, b, rhs, config)
+    hier = coarsen.build_hierarchy(
+        problem, dtype, geometry=geometry, theta=theta
+    )[: cfg.levels]
+    pc = cfg.precond_config()
+
+    def fcycle(a, b, rhs):
+        ops = _level_ops(hier, pc, fine_a=a, fine_b=b)
+        return make_fcycle(ops, nu=cfg.nu, coarse_degree=cfg.coarse_degree,
+                           n_vcycles=cfg.n_vcycles)(rhs)
+
+    # single-shot by design: the prelude runs once per guarded build and
+    # the operands are re-fed to the chunked adapter afterwards
+    x0 = jax.jit(fcycle)(a, b, rhs)  # tpulint: disable=TPU004,TPU006
+    return x0, (a, b, rhs), cfg

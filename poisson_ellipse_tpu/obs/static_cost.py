@@ -49,6 +49,7 @@ COLLECTIVE_PRIMS = (
 
 SHARDED_ENGINES = (
     "xla", "pallas", "fused", "pipelined", "mg-pcg", "cheb-pcg", "sstep",
+    "fmg",
 )
 
 # iterations advanced per while-loop body: the s-step engines run s
@@ -222,6 +223,12 @@ def _build(problem: Problem, engine: str, dtype, mode: str, mesh_shape,
                 problem, mesh, dtype,
                 kind=PRECOND_KIND_BY_ENGINE[engine],
             )
+        if engine == "fmg":
+            from poisson_ellipse_tpu.parallel.mg_sharded import (
+                build_fmg_sharded_solver,
+            )
+
+            return build_fmg_sharded_solver(problem, mesh, dtype)
         solver, args = build_sharded_solver(
             problem, mesh, dtype, stencil_impl=engine
         )

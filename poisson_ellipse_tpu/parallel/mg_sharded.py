@@ -168,11 +168,13 @@ class _MgShardSetup:
                                halo_extend(bl, px, py)))
         return level_exts
 
-    def make_precond(self, level_exts):
+    def level_ops(self, level_exts) -> list[vcycle.LevelOps]:
         """Block-layout LevelOps from the halo-extended per-level
-        coefficient blocks, composed into the generic V-cycle core."""
+        coefficient blocks — the raw per-level closures both cycle
+        shapes compose: ``make_precond`` into the V-cycle preconditioner
+        and ``build_fmg_sharded_solver`` into the F-cycle."""
         px, py, bm, bn = self.px, self.py, self.bm, self.bn
-        hier, cfg, dtype, kind = self.hier, self.cfg, self.dtype, self.kind
+        hier, cfg, dtype = self.hier, self.cfg, self.dtype
         smooth_lo, smooth_hi = self.smooth_lo, self.smooth_hi
         ops = []
         for l, (a_ext, b_ext) in enumerate(level_exts):
@@ -226,7 +228,15 @@ class _MgShardSetup:
                 restrict=restrict,
                 prolong=prolong,
             ))
-        if kind == "cheb":
+        return ops
+
+    def make_precond(self, level_exts):
+        """The per-shard ``z = M⁻¹ r`` applier: the block LevelOps
+        composed into the generic V-cycle core (or the standalone
+        Chebyshev polynomial for kind="cheb")."""
+        cfg = self.cfg
+        ops = self.level_ops(level_exts)
+        if self.kind == "cheb":
             fine = ops[0]
             return lambda r: cheby.chebyshev_apply(
                 fine.apply_a, fine.dinv, r, cfg.lo, cfg.hi, cfg.cheb_degree
@@ -441,3 +451,114 @@ def solve_mg_sharded(problem: Problem, mesh: Mesh | None = None,
         problem, mesh, dtype, kind=kind, history=history
     )
     return solver(*args)
+
+
+# -- full multigrid (the F-cycle solver), sharded ----------------------------
+
+
+def halos_per_fcycle(levels: int, nu: int = vcycle.DEFAULT_NU,
+                     coarse_degree: int = vcycle.DEFAULT_COARSE_DEGREE,
+                     n_vcycles: int = 2) -> int:
+    """Halo exchanges one sharded F-cycle costs (each 4 ppermutes) —
+    the static collective budget the jaxpr pin in ``tests/test_fmg.py``
+    checks via ``obs.static_cost``. Per level l < L−1: one RHS restrict
+    + one prolong + n_vcycles × (1 residual apply + the V-cycle over
+    levels[l:]); coarsest: the degree−1 direct sweep. The F-cycle adds
+    ZERO scalar collectives — psums stay the handoff loop's classical
+    cadence, exactly the mg-pcg discipline."""
+    if levels == 1:
+        return coarse_degree - 1
+    total = coarse_degree - 1  # the coarsest direct sweep
+    for l in range(levels - 1):
+        total += 2  # restrict f_l down + prolong x_{l+1} up
+        total += n_vcycles * (1 + halos_per_precond(
+            levels - l, nu, coarse_degree
+        ))
+    return total
+
+
+def build_fmg_sharded_solver(
+    problem: Problem,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+    config=None,
+    geometry=None,
+    theta=None,
+):
+    """(jitted solver_fn, args) for the mesh-sharded full-multigrid solve.
+
+    The F-cycle of ``mg.fmg`` over the block LevelOps of
+    :class:`_MgShardSetup` — per-level transfers and smoothing steps pay
+    one halo exchange each (``halos_per_fcycle`` is the pinned budget),
+    never a scalar collective — followed by the verified handoff: the
+    classical sharded mg-pcg loop warm-started at the F-cycle solution
+    (``_shard_init(x0_blk=...)`` rebuilds the TRUE per-shard residual),
+    running to the same δ rule as every other engine. Level padding,
+    coarsening and the Lanczos interval are exactly the mg-pcg setup's.
+
+    ``config`` is an ``mg.fmg.FMGConfig`` (None: grid-derived defaults
+    with the probed interval).
+    """
+    from poisson_ellipse_tpu.mg.fmg import (
+        FMGConfig,
+        make_fcycle,
+        resolve_fmg_config,
+    )
+
+    if mesh is None:
+        mesh = make_mesh()
+    a0, b0, rhs0 = assembly.assemble(problem, dtype, geometry=geometry,
+                                     theta=theta)
+    fmg_cfg = resolve_fmg_config(problem, a0, b0, rhs0, config)
+    assert isinstance(fmg_cfg, FMGConfig)
+    setup = _MgShardSetup(problem, mesh, dtype, "mg",
+                          fmg_cfg.precond_config(), geometry=geometry,
+                          theta=theta)
+    px, py, bm, bn = setup.px, setup.py, setup.bm, setup.bn
+    interpret = setup.interpret
+    spec = setup.spec
+    args = setup.args
+
+    def shard_fn(a_blk, b_blk, rhs_blk, *level_blks):
+        level_exts = setup.extend_levels(a_blk, b_blk, level_blks)
+        ops = setup.level_ops(level_exts)
+        x0 = make_fcycle(
+            ops, nu=fmg_cfg.nu, coarse_degree=fmg_cfg.coarse_degree,
+            n_vcycles=fmg_cfg.n_vcycles,
+        )(rhs_blk)
+        precond = vcycle.make_vcycle(
+            ops, nu=fmg_cfg.nu, coarse_degree=fmg_cfg.coarse_degree
+        )
+        stencil, pdot, d, _maskd = _shard_ops(
+            problem, px, py, bm, bn, level_exts[0][0], level_exts[0][1],
+            dtype, "xla", interpret,
+        )
+        state0 = _shard_init(
+            problem, px, py, bm, bn, pdot, d, rhs_blk, dtype,
+            precond=precond, x0_blk=x0, stencil=stencil,
+        )
+        out = _shard_advance(
+            problem, stencil, pdot, d, state0, dtype, precond=precond,
+        )
+        k, w = out[0], out[1]
+        diff, converged, breakdown = out[5], out[6], out[7]
+        return (w, k, diff, converged, breakdown)
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec,) * len(args),
+        out_specs=(spec, P(), P(), P(), P()),
+    )
+
+    def solver(*arrays):
+        w_pad, k, diff, converged, breakdown = mapped(*arrays)
+        return PCGResult(
+            w=w_pad[: problem.M + 1, : problem.N + 1],
+            iters=k,
+            diff=diff,
+            converged=converged,
+            breakdown=breakdown,
+        )
+
+    return jax.jit(solver), args

@@ -106,7 +106,8 @@ def _shard_ops(problem: Problem, px: int, py: int, bm: int, bn: int,
 
 def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
                 pdot, d, rhs_blk, dtype, history: bool = False,
-                precond=None, abft: bool = False):
+                precond=None, abft: bool = False, x0_blk=None,
+                stencil=None):
     """The full PCG carry at iteration 0 on one shard — layout matches
     ``solver.pcg.init_state`` (k, w, r, p, zr, diff, converged,
     breakdown), with w/r/p as per-shard blocks and replicated scalars.
@@ -117,11 +118,20 @@ def _shard_init(problem: Problem, px: int, py: int, bm: int, bn: int,
     closures — halo ppermutes only, no scalar collectives).
     ``abft=True`` appends the four ABFT shadow scalars
     (S_r, S_w, S_p_pred, sdc — ``resilience.abft``), anchored by one
-    stacked psum at iteration 0 (one-time, off the per-iteration path)."""
-    # the zeros literal is device-invariant; mark it varying over the mesh so
-    # the while_loop carry type matches the (varying) per-device updates
-    w0 = pcast_varying(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y))
-    r0 = rhs_blk
+    stacked psum at iteration 0 (one-time, off the per-iteration path).
+    ``x0_blk`` warm-starts the carry (w = x0 with the TRUE per-shard
+    residual r = rhs − A·x0 via ``stencil`` — the full-multigrid
+    handoff's verified seed, ``parallel.mg_sharded``'s F-cycle)."""
+    if x0_blk is None:
+        # the zeros literal is device-invariant; mark it varying over the
+        # mesh so the while_loop carry type matches the per-device updates
+        w0 = pcast_varying(jnp.zeros((bm, bn), dtype), (AXIS_X, AXIS_Y))
+        r0 = rhs_blk
+    else:
+        if stencil is None:
+            raise ValueError("x0_blk warm start needs the shard stencil")
+        w0 = x0_blk
+        r0 = rhs_blk - stencil(x0_blk)
     z0 = apply_dinv(r0, d) if precond is None else precond(r0)
     p0 = z0
     zr0 = pdot(z0, r0)

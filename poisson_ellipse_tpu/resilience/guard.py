@@ -225,7 +225,7 @@ class _ClassicalAdapter:
     def __init__(self, problem: Problem, dtype, stencil: str = "xla",
                  interpret=None, operands=None, precond_kind=None,
                  precond_config=None, geometry=None, theta=None,
-                 storage_dtype=None, sstep_s=None):
+                 storage_dtype=None, sstep_s=None, x0=None):
         from poisson_ellipse_tpu.ops.precision import resolve_storage_dtype
         from poisson_ellipse_tpu.solver.pcg import (
             advance as pcg_advance,
@@ -273,8 +273,13 @@ class _ClassicalAdapter:
             precond = None
         self.rhs_norm = float(jnp.sqrt(jnp.sum(rhs.astype(jnp.float32) ** 2)))
         st = self.storage_dtype
+        # ``x0`` warm-starts the chunked carry (w = x0, TRUE residual):
+        # the full-multigrid handoff's seed — the guard then chunk-steps,
+        # health-checks and recovers the verification loop exactly like
+        # mg-pcg, and every recover() rebuild keeps the iterate (and with
+        # it the F-cycle's head start)
         self._init = lambda: pcg_init_state(
-            problem, a, b, rhs, precond=precond, storage_dtype=st
+            problem, a, b, rhs, precond=precond, storage_dtype=st, x0=x0
         )
         # the raw chunk closure IS the production advance — exposed
         # unjitted so tests can pin the guarded jaxpr against it
@@ -1001,7 +1006,7 @@ def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
             interpret=interpret, geometry=geometry, theta=theta,
             storage_dtype=storage_dtype, sstep_s=sstep_s,
         )
-    if engine in ("mg-pcg", "cheb-pcg"):
+    if engine in ("mg-pcg", "cheb-pcg", "fmg"):
         from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
 
         if storage_dtype is not None:
@@ -1010,10 +1015,32 @@ def _make_adapter(problem: Problem, engine: str, dtype, mesh, interpret,
             # silently running full-width while the report says narrow
             # would corrupt every bandwidth comparison built on it
             raise ValueError(
-                "the preconditioner engines (mg-pcg/cheb-pcg) have no "
+                "the multigrid engines (mg-pcg/cheb-pcg/fmg) have no "
                 "storage-dtype form; drop --storage-dtype or use a "
                 "diagonal-preconditioned loop engine"
             )
+        if engine == "fmg":
+            # full multigrid under the guard: the F-cycle runs once as
+            # an (unchunked, fixed-work) prelude, then the VERIFICATION
+            # loop — warm-started mg-pcg — is what the guard chunks,
+            # health-checks and recovers; its ladder is the V-cycle's
+            # (mg → cheb → diag), and every recovery keeps the iterate,
+            # so the F-cycle's head start survives a NaN'd chunk
+            from poisson_ellipse_tpu.mg.fmg import fmg_initial_guess
+
+            x0, operands, _cfg = fmg_initial_guess(
+                problem, dtype, geometry=geometry, theta=theta
+            )
+            # the F-cycle already resolved the hierarchy + Lanczos
+            # interval; hand its config over so the verification
+            # loop's preconditioner build skips the second probe
+            adapter = _ClassicalAdapter(
+                problem, dtype, stencil="xla", operands=operands,
+                precond_kind="mg", precond_config=_cfg.precond_config(),
+                geometry=geometry, theta=theta, x0=x0,
+            )
+            adapter.engine = "fmg"
+            return adapter
         return _ClassicalAdapter(
             problem, dtype, stencil="xla",
             precond_kind=PRECOND_KIND_BY_ENGINE[engine],

@@ -199,7 +199,17 @@ class WarmPool:
         Emits ``cache:hit``/``cache:miss`` and bumps the obs counters;
         a hit returns the *same executable object* as the miss that
         created it (asserted in tests — the no-recompile contract).
+
+        ``engine="auto"`` resolves to ``batched`` — the only lane
+        engine with retire-and-refill + storage support. The tuned-
+        config consult on the serving path lives at the scheduler's
+        batch contexts (``Scheduler._ctx_for`` applies the registry's
+        per-shape chunk at warm-pool admission); the tuner never
+        scores lane engines, so there is no per-shape lane-engine
+        choice to consult here.
         """
+        if engine == "auto":
+            engine = "batched"
         key = self.key(engine, grid, dtype, lanes, norm, storage_dtype)
         entry = self.entries.get(key)
         _, bucket, dtype_name, lb, _, storage = key

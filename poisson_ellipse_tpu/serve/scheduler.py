@@ -215,6 +215,9 @@ class _BatchCtx:
         state[_IDX["bd"]] = jnp.ones((lanes,), bool)
         self.state = tuple(state)
         self.slots: list[Optional[_InFlight]] = [None] * lanes
+        # per-bucket chunk override (None = the scheduler-wide default);
+        # set at admission from the autotune registry (Scheduler._ctx_for)
+        self.chunk: Optional[int] = None
 
     @property
     def active(self) -> bool:
@@ -583,7 +586,7 @@ class Scheduler:
             # the chunk stops early at the nearest per-request iteration
             # cap (the FaultPlan.next_stop idiom): caps land exactly,
             # not at the next multiple of `chunk`
-            limit_val = min(k + self.chunk, ITER_CEILING)
+            limit_val = min(k + (ctx.chunk or self.chunk), ITER_CEILING)
             for slot in ctx.slots:
                 if slot is not None:
                     limit_val = min(
@@ -658,6 +661,23 @@ class Scheduler:
                 bucket, self.lanes, self.dtype, req.problem.norm,
                 mesh=self.mesh,
             )
+            # warm-pool admission is where the autotuner's persisted
+            # knobs land on the serving path: a tuned per-shape chunk
+            # (sized ~4 refill boundaries per predicted solve) overrides
+            # the scheduler-wide default for this bucket's context; no
+            # registry → ctx.chunk stays None and nothing changes
+            from poisson_ellipse_tpu.runtime import autotune
+
+            # keyed on the request's geometry too: a tuned config is
+            # never consulted for a domain it was not tuned for
+            tuned = autotune.lookup(req.problem, self.dtype,
+                                    geometry=req.geometry)
+            if tuned is not None and tuned.knobs.get("chunk"):
+                ctx.chunk = int(tuned.knobs["chunk"])
+                obs_trace.event(
+                    "autotune:serve-chunk", bucket=list(bucket),
+                    chunk=ctx.chunk,
+                )
             self._ctxs[key] = ctx
         return ctx
 

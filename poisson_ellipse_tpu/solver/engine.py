@@ -43,6 +43,9 @@ best path by default:
                                                        latency frontier)
   sstep-       the same blocks driving the   ~1.0x     (storage_dtype= runs
   pallas       Pallas stencil chain                    the mixed kernels)
+  fmg          ONE full-multigrid F-cycle    O(N)      (the asymptotic-work
+               + the VERIFIED mg-pcg         work,     killer — mg.fmg;
+               handoff against δ             const/pt  handoff iters ~ 1)
 
 Every STORAGE_ENGINES member additionally takes ``storage_dtype=`` —
 bf16 state/operand storage with f32 compute (``ops.precision``), the
@@ -85,11 +88,72 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 # the Pallas engine modules import solver.pcg at their top level (which
 # runs this package's __init__), so they are imported lazily here
 
-ENGINES = (
-    "auto", "xla", "fused", "resident", "streamed", "xl", "pallas",
-    "pipelined", "pipelined-pallas", "batched", "batched-pipelined",
-    "mg-pcg", "cheb-pcg", "sstep", "sstep-pallas",
-)
+# ONE engine-capability table: every per-engine fact the framework used
+# to scatter across parallel tuples (the old ENGINES / STORAGE_ENGINES /
+# HISTORY_ENGINES / PRECOND_KIND_BY_ENGINE / auto-ladder quintet, each
+# hand-maintained) lives in exactly one row here, and every consumer —
+# build_solver's dispatch, the guard, the harness, obs.static_cost AND
+# the autotuner (runtime.autotune, which reads ``tunables``) — derives
+# from it. Registering a new engine means adding ONE row.
+#
+#   family    — "loop" (XLA while_loop), "megakernel" (VMEM scalar
+#               state), "batched" (per-lane), "precond" (V-cycle/Cheb
+#               preconditioned classical loop), "sstep", "fmg"
+#   storage   — accepts the storage-vs-compute split (ops.precision)
+#   history   — can record the obs.convergence buffers
+#   capacity  — rung on the "auto" capacity ladder (0 = tried first),
+#               None = auto never picks it (opt-in engines)
+#   precond_kind — the mg.* preconditioner kind the engine's modeled
+#               extra traffic / fallback ladder keys on (None = diag)
+#   tunables  — the engine's autotunable knobs with their static
+#               defaults (what runtime.autotune turns and what tpulint
+#               TPU019 fences from being hardcoded at call sites)
+ENGINE_CAPS = {
+    "resident": dict(family="megakernel", storage=False, history=False,
+                     capacity=0, precond_kind=None, tunables={}),
+    "streamed": dict(family="megakernel", storage=True, history=False,
+                     capacity=1, precond_kind=None, tunables={}),
+    "xl": dict(family="megakernel", storage=True, history=False,
+               capacity=2, precond_kind=None, tunables={}),
+    "xla": dict(family="loop", storage=True, history=True,
+                capacity=3, precond_kind=None, tunables={}),
+    "fused": dict(family="loop", storage=False, history=True,
+                  capacity=None, precond_kind=None, tunables={}),
+    "pallas": dict(family="loop", storage=True, history=True,
+                   capacity=None, precond_kind=None, tunables={}),
+    "pipelined": dict(family="loop", storage=True, history=True,
+                      capacity=None, precond_kind=None, tunables={}),
+    "pipelined-pallas": dict(family="loop", storage=True, history=True,
+                             capacity=None, precond_kind=None, tunables={}),
+    "batched": dict(family="batched", storage=True, history=False,
+                    capacity=None, precond_kind=None,
+                    tunables={"chunk": 16}),
+    "batched-pipelined": dict(family="batched", storage=False,
+                              history=False, capacity=None,
+                              precond_kind=None, tunables={"chunk": 16}),
+    "mg-pcg": dict(family="precond", storage=False, history=True,
+                   capacity=None, precond_kind="mg",
+                   tunables={"levels": None, "nu": 2, "coarse_degree": 24}),
+    "cheb-pcg": dict(family="precond", storage=False, history=True,
+                     capacity=None, precond_kind="cheb",
+                     tunables={"cheb_degree": 12}),
+    "sstep": dict(family="sstep", storage=True, history=False,
+                  capacity=None, precond_kind=None,
+                  tunables={"sstep_s": 4}),
+    "sstep-pallas": dict(family="sstep", storage=True, history=False,
+                         capacity=None, precond_kind=None,
+                         tunables={"sstep_s": 4}),
+    # full multigrid as the SOLVER (mg.fmg): one O(N) F-cycle + the
+    # verified mg-pcg handoff. precond_kind "mg" keys its traffic model
+    # and guard fallback ladder on the V-cycle's; family "fmg" keeps it
+    # out of the precond dispatch branch (it has its own builder).
+    "fmg": dict(family="fmg", storage=False, history=True,
+                capacity=None, precond_kind="mg",
+                tunables={"levels": None, "nu": 2, "coarse_degree": 24,
+                          "n_vcycles": 2}),
+}
+
+ENGINES = ("auto",) + tuple(ENGINE_CAPS)
 
 # the s-step (communication-avoiding) engines: s iterations per
 # matrix-powers round, ONE stacked reduction (and, sharded, ONE psum +
@@ -97,7 +161,9 @@ ENGINES = (
 # parallel.sstep_sharded. "auto" never picks them (opt-in, like the
 # preconditioner engines): their payoff is collective latency and HBM
 # passes at mesh/bandwidth-bound scale, not small-grid wall clock.
-SSTEP_ENGINES = ("sstep", "sstep-pallas")
+SSTEP_ENGINES = tuple(
+    e for e, c in ENGINE_CAPS.items() if c["family"] == "sstep"
+)
 
 # engines that accept the storage-vs-compute split (ops.precision):
 # state and/or streamed operands at bf16 width in HBM, f32 compute.
@@ -105,26 +171,32 @@ SSTEP_ENGINES = ("sstep", "sstep-pallas")
 # streams (their state is VMEM-resident / kept full-width); batched
 # narrows the lane fields. The guard's escalation ladder (bf16→f32→f64)
 # is the product path for accuracy recovery (resilience.guard).
-STORAGE_ENGINES = (
-    "xla", "pallas", "pipelined", "pipelined-pallas",
-    "sstep", "sstep-pallas", "streamed", "xl", "batched",
+STORAGE_ENGINES = tuple(
+    e for e, c in ENGINE_CAPS.items() if c["storage"]
 )
 
 # the preconditioner engines (mg.*): the classical fused loop with the
 # diagonal preconditioner swapped for the multigrid V-cycle / Chebyshev
 # polynomial — same PCGResult contract, O(grid)→O(1)-ish iteration
-# counts. "auto" never picks them: auto optimises per-iteration cost at
-# a FIXED iteration count (the oracle-checked diagonal recurrence);
-# these change the iteration count itself and are opt-in per run/bench.
-# The engine-name ↔ mg-kind mapping lives HERE, once — every consumer
-# (harness, guard, static_cost, mg.engine) imports it.
-PRECOND_KIND_BY_ENGINE = {"mg-pcg": "mg", "cheb-pcg": "cheb"}
+# counts. "auto" never picks them by default: auto optimises
+# per-iteration cost at a FIXED iteration count; these change the
+# iteration count itself and are opt-in per run/bench — unless the
+# autotuner has a persisted, regression-gated winner for the shape
+# (runtime.autotune; consulted below). The engine-name ↔ mg-kind
+# mapping derives from the capability table — every consumer (harness,
+# guard, static_cost, mg.engine) imports it from here, once.
+PRECOND_KIND_BY_ENGINE = {
+    e: c["precond_kind"] for e, c in ENGINE_CAPS.items()
+    if c["family"] == "precond"
+}
 PRECOND_ENGINE_BY_KIND = {v: k for k, v in PRECOND_KIND_BY_ENGINE.items()}
 PRECOND_ENGINES = tuple(PRECOND_KIND_BY_ENGINE)
 
 # the lane-batched throughput engines (batch.*): one dispatch runs
 # ``lanes`` independent solves; results are per-lane (BatchedPCGResult)
-BATCHED_ENGINES = ("batched", "batched-pipelined")
+BATCHED_ENGINES = tuple(
+    e for e, c in ENGINE_CAPS.items() if c["family"] == "batched"
+)
 
 # engines that can record on-device convergence history
 # (``history=True`` → (PCGResult, obs.ConvergenceTrace)): the XLA-loop
@@ -132,10 +204,16 @@ BATCHED_ENGINES = ("batched", "batched-pipelined")
 # the batched engines carry per-lane recurrences — neither records.
 # "auto" resolves to xla under history=True. The single source of truth
 # for every history consumer (harness diagnose, obs.spectrum callers).
-HISTORY_ENGINES = (
-    "auto", "xla", "pallas", "fused", "pipelined", "pipelined-pallas",
-    "mg-pcg", "cheb-pcg",
+HISTORY_ENGINES = ("auto",) + tuple(
+    e for e, c in ENGINE_CAPS.items() if c["history"]
 )
+
+# the runtime capacity ladder "auto" walks (and _warm_with_degradation
+# degrades down on RESOURCE_EXHAUSTED): capability-table rungs in order
+CAPACITY_LADDER = tuple(sorted(
+    (e for e, c in ENGINE_CAPS.items() if c["capacity"] is not None),
+    key=lambda e: ENGINE_CAPS[e]["capacity"],
+))
 
 
 def select_engine(problem: Problem, dtype=jnp.float32, device=None) -> str:
@@ -167,6 +245,7 @@ def build_solver(
     problem: Problem, engine: str = "auto", dtype=jnp.float32, interpret=None,
     history: bool = False, lanes: int = 1, geometry=None, theta=None,
     validate_geometry: bool = True, storage_dtype=None, sstep_s: int = 4,
+    tuned_knobs: dict | None = None,
 ):
     """(jitted solver, args, resolved_engine) for a single-chip solve.
 
@@ -200,6 +279,13 @@ def build_solver(
     xl) keep their scalars in kernel scratch, so "auto" with history
     resolves to xla (the reference-trajectory engine) and an explicit
     mega-kernel request fails loudly.
+
+    ``tuned_knobs`` is the autotune registry's knob dict for this shape
+    (``runtime.autotune``): the multigrid builders apply
+    levels/ν/degrees/n_vcycles, the s-step branch reads sstep_s —
+    passed explicitly by the tuner's measurement path and filled
+    automatically when "auto" consults a persisted config, so the
+    configuration that was scored is the configuration that runs.
 
     "auto" degrades gracefully: the capacity gates are budgets measured
     on the bench part, so on a chip with a different VMEM size a selected
@@ -264,6 +350,27 @@ def build_solver(
         # operands on every dispatch (the timing protocols re-dispatch)
         solver = jax.jit(run)  # tpulint: disable=TPU004
         return solver, args, engine
+    if engine == "auto":
+        # the autotuner's persisted, regression-gated winner for this
+        # shape (runtime.autotune) overrides the static capacity ladder
+        # — only when a tuned registry exists next to the XLA cache and
+        # holds this key; otherwise the historical ladder is untouched
+        from poisson_ellipse_tpu.runtime import autotune
+
+        tuned = autotune.lookup(problem, dtype, storage_dtype=storage_dtype,
+                                geometry=geometry)
+        if tuned is not None and tuned.engine in ENGINE_CAPS:
+            caps = ENGINE_CAPS[tuned.engine]
+            if ((not history or caps["history"])
+                    and (storage_dtype is None or caps["storage"])
+                    and caps["family"] not in ("batched",)):
+                engine = tuned.engine
+                # the FULL knob dict rides along: the multigrid/sstep
+                # builders below apply it, so the tuned configuration
+                # is what actually runs, not just the engine name
+                tuned_knobs = dict(tuned.knobs)
+                if "sstep_s" in tuned_knobs:
+                    sstep_s = int(tuned_knobs["sstep_s"])
     if engine == "auto" and history:
         # the mega-kernel engines auto would pick cannot record: take the
         # reference-trajectory engine instead of failing a telemetry ask
@@ -278,7 +385,7 @@ def build_solver(
     if engine == "auto":
         import jax
 
-        chain = ("resident", "streamed", "xl", "xla")
+        chain = CAPACITY_LADDER
         chain = chain[chain.index(select_engine(problem, dtype)):]
         last_err = None
         for cand in chain:
@@ -339,15 +446,31 @@ def build_solver(
             problem, dtype, interpret=interpret, geometry=geometry,
             theta=theta, storage_dtype=storage_dtype,
         )
+    elif engine == "fmg":
+        # full multigrid as the solver: one O(N) F-cycle (nested
+        # iteration over the coarsened hierarchy) + the verified
+        # warm-started mg-pcg handoff against δ (mg.fmg); tuned knobs
+        # (levels/ν/coarse_degree/n_vcycles) become the F-cycle config
+        from poisson_ellipse_tpu.mg.fmg import (
+            build_fmg_solver,
+            config_from_knobs,
+        )
+
+        solver, args, _ = build_fmg_solver(
+            problem, dtype, history=history, geometry=geometry,
+            theta=theta, config=config_from_knobs(problem, tuned_knobs),
+        )
     elif engine in PRECOND_ENGINES:
         # the multigrid / Chebyshev preconditioned classical loop: the
         # hierarchy + Lanczos bounds are resolved at build time, the
-        # V-cycle/polynomial runs inside the fused while_loop (mg.engine)
+        # V-cycle/polynomial runs inside the fused while_loop
+        # (mg.engine); tuned knobs override the probed config's cycle
+        # shape (the interval stays the probe's)
         from poisson_ellipse_tpu.mg.engine import build_precond_solver
 
         solver, args, _ = build_precond_solver(
             problem, engine, dtype, history=history, geometry=geometry,
-            theta=theta,
+            theta=theta, overrides=tuned_knobs,
         )
     elif engine in ("pipelined", "pipelined-pallas"):
         from poisson_ellipse_tpu.ops.pipelined_pcg import pcg_pipelined

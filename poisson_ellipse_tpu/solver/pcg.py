@@ -60,7 +60,7 @@ class PCGResult(NamedTuple):
 
 
 def init_state(problem: Problem, a, b, rhs, history: bool = False,
-               precond=None, storage_dtype=None):
+               precond=None, storage_dtype=None, x0=None):
     """The PCG carry at iteration 0 (the resumable solver state).
 
     Layout: (k, w, r, p, zr, diff, converged, breakdown) — everything the
@@ -77,18 +77,27 @@ def init_state(problem: Problem, a, b, rhs, history: bool = False,
     fields (w, r, p) at that width — bf16 halves their HBM footprint —
     while the scalar recurrence (zr, diff) stays at compute width; None
     is byte-identical to the pre-storage-axis carry.
+
+    ``x0`` warm-starts the recurrence: w = x0 with the TRUE residual
+    r = rhs − A·x0 — the full-multigrid handoff (``mg.fmg``) seeds the
+    loop with the F-cycle solution and the loop *verifies* it against δ
+    instead of trusting it. ``x0=None`` is byte-identical to the
+    historical zero start (r = rhs, no stencil application).
     """
     dtype = rhs.dtype
     st = resolve_storage_dtype(storage_dtype, dtype)
     h1 = jnp.asarray(problem.h1, dtype)
     h2 = jnp.asarray(problem.h2, dtype)
     d = diag_d(a, b, h1, h2)
-    r0 = rhs
+    if x0 is None:
+        w0, r0 = jnp.zeros_like(rhs), rhs
+    else:
+        w0, r0 = x0, rhs - apply_a(x0, a, b, h1, h2)
     z0 = apply_dinv(r0, d) if precond is None else precond(r0)
     zr0 = grid_dot(z0, r0, h1, h2)
     state = (
         jnp.asarray(0, jnp.int32),
-        jnp.zeros_like(rhs, dtype=st or rhs.dtype),
+        _store(w0, st),
         _store(r0, st),
         _store(z0, st),  # p0 = z0
         zr0,

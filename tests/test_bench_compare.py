@@ -511,3 +511,75 @@ def test_bandwidth_hard_pins_fire_on_the_new_round_alone():
     off = make_round(bandwidth=_bw(parity=False))
     regs = regressions_between(old, off)
     assert ("bandwidth_l2_parity", "bandwidth pipelined/bf16") in regs
+
+
+# ------------------------------------------------------- fmg / autotune
+
+
+def _fmg_round(t1=0.05, t2=0.4, wu=True, headline_speedup=1.4):
+    return make_round(fmg={
+        "work_units_constant": wu,
+        "rows": [
+            {"grid": [400, 600], "t_solver_s": t1, "iters": 3,
+             "work_units_per_point": 60.0, "headline": False},
+            {"grid": [4096, 4096], "t_solver_s": t2, "iters": 3,
+             "work_units_per_point": 62.0, "headline": True,
+             "speedup_vs_mg": headline_speedup},
+        ],
+    })
+
+
+def test_fmg_slowdown_is_a_regression_per_grid():
+    old, new = _fmg_round(), _fmg_round(t1=0.05 * 1.5)
+    assert ("fmg_t_solver_s", "fmg 400x600") in regressions_between(old, new)
+    assert regressions_between(old, _fmg_round(t1=0.05 * 1.1)) == []
+
+
+def test_fmg_hard_pins_fire_on_the_new_round_alone():
+    old = _fmg_round()
+    regs = regressions_between(old, _fmg_round(wu=False))
+    assert ("fmg_work_units", "fmg") in regs
+    regs = regressions_between(old, _fmg_round(headline_speedup=0.8))
+    assert ("fmg_headline_speedup", "fmg 4096x4096") in regs
+
+
+def test_fmg_only_in_one_round_is_noted_not_failed():
+    old, new = make_round(), _fmg_round()
+    regs, notes = bc.compare(old, new, TOL)
+    assert not regs
+    assert any("fmg" in n for n in notes)
+
+
+def _autotune_round(t=0.02, loses=False, roundtrip=True):
+    return make_round(autotune={
+        "rows": [
+            {"grid": [400, 600], "tuned_engine": "fmg",
+             "static_engine": "xl", "tuned_t_s": t, "static_t_s": 0.05,
+             "tuned_loses": loses, "roundtrip_ok": roundtrip},
+        ],
+    })
+
+
+def test_autotune_tuned_slowdown_is_a_regression():
+    old, new = _autotune_round(), _autotune_round(t=0.02 * 1.5)
+    assert ("autotune_tuned_t_s", "autotune 400x600") in \
+        regressions_between(old, new)
+    assert regressions_between(old, _autotune_round(t=0.02 * 1.1)) == []
+
+
+def test_autotune_never_loses_pin_fires_on_the_new_round_alone():
+    # a new round whose tuned config lost to the static default fails
+    # even against an old round that also carried the key cleanly
+    regs = regressions_between(_autotune_round(), _autotune_round(loses=True))
+    assert ("autotune_tuned_loses", "autotune 400x600") in regs
+    regs = regressions_between(
+        _autotune_round(), _autotune_round(roundtrip=False)
+    )
+    assert ("autotune_roundtrip", "autotune 400x600") in regs
+
+
+def test_autotune_only_in_one_round_is_noted_not_failed():
+    old, new = make_round(), _autotune_round()
+    regs, notes = bc.compare(old, new, TOL)
+    assert not regs
+    assert any("autotune" in n for n in notes)

@@ -27,10 +27,10 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_eighteen_rules():
+def test_registry_has_all_nineteen_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
         "TPU010", "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
-        "TPU016", "TPU017", "TPU018",
+        "TPU016", "TPU017", "TPU018", "TPU019",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -1961,5 +1961,71 @@ def test_tpu018_suppression_comment():
     src = """
         import jax.numpy as jnp
         s = jnp.sum(x.astype(jnp.bfloat16))  # tpulint: disable=TPU018
+    """
+    assert codes_of(src) == []
+
+
+# -- TPU019: hardcoded tunable knobs -----------------------------------------
+
+
+def test_tpu019_positive_literal_knob_at_builder_call():
+    src = """
+        solver, args, _ = build_solver(problem, "sstep", dtype, sstep_s=2)
+        factory, cfg = make_precond(problem, dtype, "cheb", cheb_degree=16)
+    """
+    assert codes_of(src) == ["TPU019", "TPU019"]
+
+
+def test_tpu019_positive_chunk_and_fcycle_knobs():
+    src = """
+        guarded = guarded_solve(problem, "xla", dtype, chunk=4)
+        cyc = make_fcycle(ops, n_vcycles=3)
+    """
+    assert codes_of(src) == ["TPU019", "TPU019"]
+
+
+def test_tpu019_negative_named_constant_and_variable():
+    src = """
+        DEGREE = 16
+        factory, cfg = make_precond(problem, dtype, "cheb", cheb_degree=DEGREE)
+        solver, args, _ = build_solver(problem, "sstep", dtype, sstep_s=args.s)
+        cyc = make_fcycle(ops, n_vcycles=cfg.n_vcycles)
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu019_negative_default_config_and_tuner_exempt():
+    src = """
+        def default_fmg_config(problem):
+            return make_fcycle(ops, n_vcycles=2, coarse_degree=24)
+
+        def tune_candidates(problem):
+            return [make_precond(problem, dtype, "cheb", cheb_degree=8)]
+    """
+    # the registry-definition sites: static defaults and candidate
+    # sweeps are the one place a knob literal must live
+    assert codes_of(src) == []
+
+
+def test_tpu019_negative_non_builder_call_and_other_kwargs():
+    src = """
+        x = compute(problem, chunk=4)
+        solver, args, _ = build_solver(problem, "xla", dtype, lanes=1)
+    """
+    # `compute` is not a tunable-fns callee; `lanes` is not a knob
+    assert codes_of(src) == []
+
+
+def test_tpu019_tunable_fns_config_knob():
+    src = """
+        s = my_builder(problem, cheb_degree=12)
+    """
+    assert codes_of(src) == []
+    assert codes_of(src, tunable_fns=("my_builder",)) == ["TPU019"]
+
+
+def test_tpu019_suppression_comment():
+    src = """
+        g = guarded_solve(problem, "xla", dtype, chunk=4)  # tpulint: disable=TPU019
     """
     assert codes_of(src) == []

@@ -568,3 +568,79 @@ def test_bandwidth_absent_or_failed_is_supported(workspace):
     assert urb.bandwidth_lines(
         make_artifact(bandwidth={"available": True, "cells": []})
     ) == []
+
+
+def test_fmg_table_rendered_when_present(workspace):
+    rec = make_artifact(fmg={
+        "work_units_constant": True,
+        "rows": [
+            {"grid": [400, 600], "t_solver_s": 0.012, "iters": 3,
+             "work_units_per_point": 60.5, "speedup_vs_mg": 1.3},
+            {"grid": [4096, 4096], "t_solver_s": 0.31, "iters": 3,
+             "work_units_per_point": 62.1, "speedup_vs_mg": 2.4,
+             "headline": True},
+        ],
+    })
+    text = "\n".join(urb.fmg_lines(rec))
+    assert "Full multigrid as the solver" in text
+    assert "work units per grid point constant" in text
+    assert "| 4096×4096 (headline) |" in text
+    assert "**2.4×**" in text
+
+
+def test_fmg_absent_or_failed_is_supported(workspace):
+    assert urb.fmg_lines(make_artifact()) == []
+    assert urb.fmg_lines(make_artifact(fmg={"rows": []})) == []
+    # a failed row (no t_solver_s) is skipped, not a crash
+    assert urb.fmg_lines(make_artifact(fmg={
+        "work_units_constant": True,
+        "rows": [{"grid": [400, 600], "error": "OOM"}],
+    })) == []
+
+
+def test_autotune_table_rendered_when_present(workspace):
+    rec = make_artifact(autotune={
+        "rows": [
+            {"grid": [400, 600], "tuned_engine": "fmg",
+             "static_engine": "xl", "tuned_t_s": 0.012,
+             "static_t_s": 0.05, "tuned_loses": False,
+             "roundtrip_ok": True},
+            {"grid": [100, 200], "tuned_engine": "resident",
+             "static_engine": "resident", "tuned_t_s": 0.004,
+             "static_t_s": 0.004, "tuned_loses": False,
+             "roundtrip_ok": True},
+        ],
+    })
+    text = "\n".join(urb.autotune_lines(rec))
+    assert "Telemetry-driven autotuning" in text
+    assert "tuned wins" in text
+    assert "static stands" in text
+
+
+def test_autotune_absent_or_failed_is_supported(workspace):
+    assert urb.autotune_lines(make_artifact()) == []
+    assert urb.autotune_lines(make_artifact(autotune={"rows": []})) == []
+    assert urb.autotune_lines(make_artifact(autotune={
+        "rows": [{"grid": [400, 600], "error": "probe failed"}],
+    })) == []
+
+
+def test_fmg_and_autotune_ride_the_table_block(workspace):
+    _tmp, readme, artifact = workspace
+    rec = make_artifact(
+        fmg={"work_units_constant": True, "rows": [
+            {"grid": [400, 600], "t_solver_s": 0.012, "iters": 3,
+             "work_units_per_point": 60.5, "speedup_vs_mg": 1.3},
+        ]},
+        autotune={"rows": [
+            {"grid": [400, 600], "tuned_engine": "fmg",
+             "static_engine": "xl", "tuned_t_s": 0.012,
+             "static_t_s": 0.05, "tuned_loses": False,
+             "roundtrip_ok": True},
+        ]},
+    )
+    artifact.write_text(json.dumps(rec))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Full multigrid as the solver" in text
+    assert "Telemetry-driven autotuning" in text

@@ -37,6 +37,14 @@ older artifacts predate newer keys, which must never fail the gate):
   than `grad-pct`, and the per-grid adjoint/primal iteration ratio
   growing past the same band (the adjoint must stay "one extra solve
   with the same operator", not drift into its own convergence story)
+- `fmg` rows (keyed by grid): F-cycle `t_solver_s` slower than
+  `fmg-pct`, the constant-work-units-per-point pin breaking in the new
+  round (the O(N) claim), or a headline row's wall-clock-vs-mg-pcg
+  acceptance breaking
+- `autotune` rows (keyed by grid): `tuned_t_s` slower than
+  `autotune-pct` between rounds; hard pins in the new round — a tuned
+  config that measures slower than the static default (`tuned_loses`)
+  or a broken registry round-trip is a regression outright
 
 Tolerances live in `pyproject.toml [tool.bench_compare]` (shared by the
 CLI and the driver-dryrun smoke gate); built-in defaults apply when the
@@ -87,6 +95,14 @@ DEFAULT_TOLERANCES = {
     # per-cell T_solver/GB/s share the wall-clock noise floor; the
     # ≤0.6× byte ratio and the l2 parity flag are hard pins per round
     "bandwidth-pct": 0.25,
+    # fmg rows (full multigrid as the solver): per-grid T_solver shares
+    # the wall-clock noise floor; the work-units-constant pin and the
+    # headline wall-clock-vs-mg-pcg acceptance are hard pins per round
+    "fmg-pct": 0.25,
+    # autotune rows: tuned wall clock per shape shares the noise floor;
+    # `tuned_loses` (a tuned config measuring slower than the static
+    # default) and a broken registry round-trip are hard pins per round
+    "autotune-pct": 0.25,
 }
 
 # scalar-row artifact keys carrying {grid, t_solver_s, iters}
@@ -525,6 +541,91 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
                 ))
     if bool(o_bw) != bool(n_bw):
         notes.append("bandwidth: only in one round, skipped")
+
+    # the fmg key: per-grid T_solver drift between rounds under
+    # `fmg-pct`, plus two hard pins carried by the new round itself —
+    # the constant-work-units pin (the O(N) claim) and every headline
+    # row's wall-clock-vs-mg-pcg acceptance
+    def fmg_rows(rec):
+        row = rec.get("fmg")
+        if not isinstance(row, dict):
+            return {}
+        return {
+            tuple(r["grid"]): r for r in row.get("rows") or []
+            if r.get("grid")
+        }
+
+    o_fmg, n_fmg = fmg_rows(old), fmg_rows(new)
+    for key in sorted(o_fmg.keys() & n_fmg.keys()):
+        where_fmg = f"fmg {_grid_label(key)}"
+        o_t, n_t = o_fmg[key].get("t_solver_s"), n_fmg[key].get("t_solver_s")
+        if not one_sided("fmg t_solver_s", where_fmg, o_t, n_t) and \
+                o_t and n_t is not None:
+            limit = tol["fmg-pct"]
+            if n_t > o_t * (1.0 + limit):
+                regressions.append(Regression(
+                    "fmg_t_solver_s", where_fmg, o_t, n_t,
+                    f"+{(n_t / o_t - 1):.0%} > +{limit:.0%}",
+                ))
+    if n_fmg:
+        if new.get("fmg", {}).get("work_units_constant") is False:
+            regressions.append(Regression(
+                "fmg_work_units", "fmg", 1, 0,
+                "work units per grid point left the ±20% constant band "
+                "(the O(N) pin broke)",
+            ))
+        for key, row in sorted(n_fmg.items()):
+            sp = row.get("speedup_vs_mg")
+            if row.get("headline") and sp is not None and sp < 1.0:
+                regressions.append(Regression(
+                    "fmg_headline_speedup", f"fmg {_grid_label(key)}",
+                    1.0, sp,
+                    "headline F-cycle slower than mg-pcg at equal "
+                    "accuracy (the wall-clock acceptance broke)",
+                ))
+    if bool(o_fmg) != bool(n_fmg):
+        notes.append("fmg: only in one round, skipped")
+
+    # the autotune key: tuned wall clock per shape under `autotune-pct`
+    # between rounds, plus the hard pins in the new round — a tuned
+    # config must never lose to the static default, and the persisted
+    # registry must round-trip
+    def tune_rows(rec):
+        row = rec.get("autotune")
+        if not isinstance(row, dict):
+            return {}
+        return {
+            tuple(r["grid"]): r for r in row.get("rows") or []
+            if r.get("grid")
+        }
+
+    o_at, n_at = tune_rows(old), tune_rows(new)
+    for key in sorted(o_at.keys() & n_at.keys()):
+        where_at = f"autotune {_grid_label(key)}"
+        o_t, n_t = o_at[key].get("tuned_t_s"), n_at[key].get("tuned_t_s")
+        if not one_sided("autotune tuned_t_s", where_at, o_t, n_t) and \
+                o_t and n_t is not None:
+            limit = tol["autotune-pct"]
+            if n_t > o_t * (1.0 + limit):
+                regressions.append(Regression(
+                    "autotune_tuned_t_s", where_at, o_t, n_t,
+                    f"+{(n_t / o_t - 1):.0%} > +{limit:.0%}",
+                ))
+    for key, row in sorted(n_at.items()):
+        if row.get("tuned_loses"):
+            regressions.append(Regression(
+                "autotune_tuned_loses", f"autotune {_grid_label(key)}",
+                row.get("static_t_s"), row.get("tuned_t_s"),
+                "tuned config loses to the static default (the "
+                "never-loses contract broke)",
+            ))
+        if row.get("roundtrip_ok") is False:
+            regressions.append(Regression(
+                "autotune_roundtrip", f"autotune {_grid_label(key)}",
+                1, 0, "tuned-config registry round-trip broke",
+            ))
+    if bool(o_at) != bool(n_at):
+        notes.append("autotune: only in one round, skipped")
 
     return regressions, notes
 

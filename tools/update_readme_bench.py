@@ -216,7 +216,96 @@ def table_block(rec: dict, src: str) -> str:
     bandwidth = bandwidth_lines(rec)
     if bandwidth:
         lines += [""] + bandwidth
+    fmg = fmg_lines(rec)
+    if fmg:
+        lines += [""] + fmg
+    autotune = autotune_lines(rec)
+    if autotune:
+        lines += [""] + autotune
     return "\n".join(lines)
+
+
+def fmg_lines(rec: dict) -> list[str]:
+    """Markdown for the artifact's ``fmg`` key (full multigrid as the
+    solver, emitted since mg/fmg landed): T_solver + work units per
+    grid point vs mg-pcg per grid. Pre-FMG artifacts lack the key and
+    render without the table; a failed row (no t_solver_s) is skipped,
+    not a crash."""
+    fmg = rec.get("fmg")
+    if not isinstance(fmg, dict):
+        return []
+    rows = [
+        r for r in (fmg.get("rows") or [])
+        if r.get("t_solver_s") and r.get("grid")
+    ]
+    if not rows:
+        return []
+    wu_pin = (
+        "work units per grid point constant across grids (the O(N) pin)"
+        if fmg.get("work_units_constant")
+        else "WORK-UNIT PIN BROKEN"
+    )
+    lines = [
+        "Full multigrid as the solver (`mg/fmg`: one O(N) F-cycle + a "
+        "VERIFIED mg-pcg handoff against δ — accuracy measured, never "
+        f"assumed; {wu_pin}; `fmg-pct` regression-gated by "
+        "`tools/bench_compare.py`):",
+        "",
+        "| Grid | T_solver | handoff iters | work units/pt | vs mg-pcg |",
+        "|---|---|---|---|---|",
+    ]
+    for r in rows:
+        M, N = r["grid"]
+        vs = (
+            f"**{r['speedup_vs_mg']:g}×**"
+            if r.get("speedup_vs_mg") else "—"
+        )
+        head = " (headline)" if r.get("headline") else ""
+        lines.append(
+            f"| {M}×{N}{head} | {fmt_t(r['t_solver_s'])} | "
+            f"{r.get('iters', '—')} | "
+            f"{r.get('work_units_per_point', '—')} | {vs} |"
+        )
+    return lines
+
+
+def autotune_lines(rec: dict) -> list[str]:
+    """Markdown for the artifact's ``autotune`` key (the closed-loop
+    tuner, emitted since runtime/autotune landed): tuned-vs-static wall
+    clock per shape. Pre-tuner artifacts lack the key and render
+    without the table; a failed row (no tuned_t_s) is skipped."""
+    at = rec.get("autotune")
+    if not isinstance(at, dict):
+        return []
+    rows = [
+        r for r in (at.get("rows") or [])
+        if r.get("tuned_t_s") and r.get("grid")
+    ]
+    if not rows:
+        return []
+    lines = [
+        "Telemetry-driven autotuning (`runtime.autotune`: per-shape "
+        "configs scored from measured κ/Ritz predictions and GB/s, "
+        "persisted next to the XLA cache; a tuned config that loses to "
+        "the static default fails the `autotune-pct` gate):",
+        "",
+        "| Grid | tuned engine | tuned | static default | verdict |",
+        "|---|---|---|---|---|",
+    ]
+    for r in rows:
+        M, N = r["grid"]
+        verdict = (
+            "TUNED LOSES (gate fails)" if r.get("tuned_loses")
+            else ("static stands" if r.get("tuned_engine")
+                  == r.get("static_engine") else "tuned wins")
+        )
+        lines.append(
+            f"| {M}×{N} | {r.get('tuned_engine', '—')} | "
+            f"{fmt_t(r['tuned_t_s'])} | "
+            f"{fmt_t(r['static_t_s'])} ({r.get('static_engine', '?')}) | "
+            f"{verdict} |"
+        )
+    return lines
 
 
 def bandwidth_lines(rec: dict) -> list[str]:
