@@ -1,0 +1,34 @@
+"""analysis/ — whole-matrix static contract checking over jaxprs.
+
+Three layers, one import rule:
+
+- :mod:`.jaxpr_scan` — the traversal engine (``make_jaxpr``-based, no
+  compiles, no devices); ``obs.static_cost`` consumes it too.
+- :mod:`.contracts` — the declarative contract schema; expected values
+  derive from ``solver.engine.ENGINE_CAPS``'s per-row ``contracts``
+  metadata. Tests call ``assert_contract(...)``.
+- :mod:`.matrix` — the engine × axis sweep, JSON/SARIF reports, and the
+  classified exit contract (``python -m poisson_ellipse_tpu.analysis``).
+
+This package ``__init__`` stays import-light on purpose: :mod:`.sarif`
+is pure stdlib and is imported by the tpulint CLI, which must never pull
+in JAX — reach the JAX-facing modules by their full names.
+"""
+
+from __future__ import annotations
+
+__all__ = ["assert_contract", "check_contract", "run_matrix"]
+
+
+def __getattr__(name: str):
+    # lazy: keep `import poisson_ellipse_tpu.analysis.sarif` (the lint
+    # CLI's path) from importing jax via the contract machinery
+    if name in ("assert_contract", "check_contract"):
+        from poisson_ellipse_tpu.analysis import contracts
+
+        return getattr(contracts, name)
+    if name == "run_matrix":
+        from poisson_ellipse_tpu.analysis import matrix
+
+        return matrix.run_matrix
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,130 @@
+"""CLI: ``python -m poisson_ellipse_tpu.analysis`` — the contract matrix.
+
+Runs the full ENGINE_CAPS-derived engine × axis sweep on a tiny grid,
+by abstract tracing only, on the CPU backend (forced here — the checker
+needs no accelerator, and CI must not wait for one)::
+
+    python -m poisson_ellipse_tpu.analysis                     # text
+    python -m poisson_ellipse_tpu.analysis --format json
+    python -m poisson_ellipse_tpu.analysis --format sarif -o out.sarif
+    python -m poisson_ellipse_tpu.analysis --engine pipelined --axis sharded
+    python -m poisson_ellipse_tpu.analysis --list-contracts
+
+Exit status mirrors tpulint: 0 clean (including suppressed cells),
+1 contract violations, 2 a cell errored out / bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_cpu() -> None:
+    """Pin the CPU backend with a virtual mesh BEFORE jax initialises —
+    the same order-sensitive ritual the test conftest and the driver
+    dryrun use (parallel.mesh.virtual_cpu_devices)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from poisson_ellipse_tpu.parallel.mesh import virtual_cpu_devices
+
+    virtual_cpu_devices(8)
+    jax.config.update("jax_enable_x64", True)
+
+
+def main(argv=None) -> int:
+    from poisson_ellipse_tpu.analysis.contracts import CONTRACT_KINDS
+
+    parser = argparse.ArgumentParser(
+        prog="python -m poisson_ellipse_tpu.analysis",
+        description="Jaxpr-level engine-contract matrix (expected values "
+        "from solver.engine.ENGINE_CAPS; suppress cells via "
+        "[tool.engine_contracts] in pyproject.toml).",
+    )
+    parser.add_argument(
+        "--engine", action="append", default=None,
+        help="restrict to an engine (repeatable; default: every "
+        "ENGINE_CAPS row)",
+    )
+    parser.add_argument(
+        "--axis", action="append", default=None,
+        choices=None, help="restrict to an axis (repeatable): single, "
+        "sharded, batched, guarded, abft, storage, history",
+    )
+    parser.add_argument(
+        "--grid", type=int, nargs=2, default=None, metavar=("M", "N"),
+        help="trace grid (default 16 16)",
+    )
+    parser.add_argument(
+        "--mesh", type=int, nargs=2, default=(1, 2), metavar=("PX", "PY"),
+        help="mesh shape for the sharded cells (default 1 2)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="report format (default text)",
+    )
+    parser.add_argument(
+        "-o", "--output", default=None,
+        help="write the report to a file instead of stdout (text summary "
+        "still prints)",
+    )
+    parser.add_argument(
+        "--no-suppressions", action="store_true",
+        help="ignore [tool.engine_contracts] suppress entries",
+    )
+    parser.add_argument(
+        "--hash", action="store_true",
+        help="print the canonical report hash (what bench rounds embed)",
+    )
+    parser.add_argument(
+        "--list-contracts", action="store_true",
+        help="print the contract-kind table and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_contracts:
+        for kind, desc in CONTRACT_KINDS.items():
+            print(f"{kind:24s} {desc}")
+        return 0
+
+    _force_cpu()
+    from poisson_ellipse_tpu.analysis import matrix
+    from poisson_ellipse_tpu.models.problem import Problem
+
+    problem = Problem(M=args.grid[0], N=args.grid[1]) if args.grid else None
+    try:
+        report = matrix.run_matrix(
+            tuple(args.engine) if args.engine else None,
+            tuple(args.axis) if args.axis else None,
+            problem=problem,
+            mesh_shape=tuple(args.mesh),
+            suppressions={} if args.no_suppressions else None,
+        )
+    except SystemExit as e:  # malformed suppress entry = bad usage
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        rendered = json.dumps(report, indent=2, sort_keys=True)
+    elif args.format == "sarif":
+        rendered = json.dumps(
+            matrix.report_to_sarif(report), indent=2, sort_keys=True
+        )
+    else:
+        rendered = matrix.render_report(report)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(rendered + "\n")
+        print(matrix.render_report(report))
+    else:
+        print(rendered)
+    if args.hash:
+        print(f"report-hash: {matrix.report_hash(report)}")
+    return matrix.exit_code(report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,698 @@
+"""Declarative engine contracts over traced jaxprs.
+
+Every structural guarantee the engine zoo advertises — the pipelined
+loop's ONE stacked psum per iteration, the classical 2-psum/4-ppermute
+cadence, the s-step body's one reduction per s iterations, the V-cycle's
+``halos_per_precond`` ppermute budget, ABFT-on/off collective identity,
+the guard's byte-identical chunk advance, ``storage_dtype=None`` byte
+identity, history-off costing zero — is one *contract*: a named
+predicate over a traced computation, with its expected values derived
+from ``solver.engine.ENGINE_CAPS``'s per-row ``contracts`` metadata.
+An engine registered without that metadata is itself a finding
+(``engine-metadata``): declaring the structural contract is part of
+registering the engine.
+
+The checks are ``jax.make_jaxpr``/``jax.eval_shape`` based — abstract
+tracing through the real product builders, no solver compiles, no
+devices beyond the host CPU mesh. Tests call :func:`assert_contract`
+(the one-line form of the old hand-written jaxpr pins); the matrix
+runner (``analysis.matrix`` / ``python -m poisson_ellipse_tpu.analysis``)
+sweeps every applicable (engine × axis) cell.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from poisson_ellipse_tpu.analysis import jaxpr_scan
+from poisson_ellipse_tpu.models.problem import Problem
+
+# deviations-from-default schema for ENGINE_CAPS rows' ``contracts``
+# key; an unknown key in a row is a finding (a typo'd contract would
+# otherwise silently never run)
+CONTRACT_DEFAULTS: dict = {
+    # single-chip trace carries zero collective primitives
+    "single_collective_free": True,
+    # sharded while-body cadence: psums per body (None = no sharded form)
+    "sharded_psum": None,
+    # halo exchanges per sharded body, 4 ppermutes each; "precond" =
+    # stencil + halos_per_precond(cfg); None = deliberately unpinned
+    "sharded_halo": None,
+    # lane-sharded (batched mesh) cadence
+    "batched_psum": None,
+    "batched_halo": None,
+    # the ABFT stepper adds ZERO collectives (on/off identity)
+    "abft": False,
+    # guard adapter family whose chunk advance traces byte-identically
+    "guard": None,
+    # storage_dtype=None traces the byte-identical pre-storage jaxpr
+    "storage_identity": False,
+    # bf16 storage: the body widens on load and narrows on store
+    "storage_narrow": False,
+    # history=True stays device-resident; history=False adds no DUS
+    "history_resident": False,
+    # fmg: whole-trace ppermute budget (halos_per_fcycle) applies
+    "fcycle_budget": False,
+}
+
+# classical carry width: the history-off loop must keep the original
+# 8-tuple carry (a 9th outvar means the telemetry leaked into the
+# default path) — a property of the classical recurrence, keyed here
+# because only the classical engine pins it
+_HISTORY_OUTVARS = {"xla": 8}
+
+# contract kind -> one-line description (the --list-contracts table and
+# the README row source)
+CONTRACT_KINDS = {
+    "engine-metadata": (
+        "every ENGINE_CAPS row declares a contracts dict with known keys"
+    ),
+    "single-collective-free": (
+        "single-chip trace holds zero collective primitives"
+    ),
+    "collective-cadence": (
+        "sharded while-body psum/ppermute counts match the declared "
+        "cadence (halo budgets via halos_per_precond where declared)"
+    ),
+    "batched-cadence": (
+        "lane-sharded while body holds exactly the declared collectives "
+        "(one convergence-word psum, zero ppermutes)"
+    ),
+    "abft-identity": (
+        "the ABFT stepper's per-body collective counts equal the "
+        "unchecked stepper's — fault detection adds zero collectives"
+    ),
+    "guard-overhead": (
+        "the guard adapter's chunk advance traces the byte-identical "
+        "jaxpr of the unguarded advance"
+    ),
+    "storage-identity": (
+        "storage_dtype=None traces the byte-identical pre-storage jaxpr"
+    ),
+    "storage-narrow": (
+        "a bf16-storage loop body widens narrow state on load and "
+        "narrows on store (no narrow leg under full-width builds)"
+    ),
+    "history-free": (
+        "history=False traces the byte-identical default jaxpr with no "
+        "dynamic_update_slice (and the original carry width)"
+    ),
+    "history-resident": (
+        "history=True records via dynamic_update_slice with no host "
+        "callbacks — device-resident telemetry"
+    ),
+    "fcycle-budget": (
+        "the sharded F-cycle's whole-trace ppermute total equals the "
+        "halos_per_fcycle budget — no hidden exchanges"
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One broken contract at one matrix cell."""
+
+    kind: str
+    engine: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.engine}: {self.kind}: {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractResult:
+    """The outcome of one contract check at one cell."""
+
+    kind: str
+    engine: str
+    status: str  # "pass" | "fail"
+    expected: object = None
+    actual: object = None
+    violations: tuple[Violation, ...] = ()
+
+
+def engine_contract_spec(engine: str, caps: Optional[dict] = None) -> dict:
+    """The engine's full contract spec: row metadata over the defaults.
+
+    Raises ``KeyError`` for an unregistered engine and ``ValueError``
+    for a row without ``contracts`` metadata or with unknown keys — the
+    same conditions the ``engine-metadata`` contract reports as
+    findings.
+    """
+    if caps is None:
+        from poisson_ellipse_tpu.solver.engine import ENGINE_CAPS
+
+        caps = ENGINE_CAPS
+    row = caps[engine]
+    if "contracts" not in row:
+        raise ValueError(
+            f"engine {engine!r} is registered without contract metadata "
+            "(ENGINE_CAPS row has no 'contracts' key)"
+        )
+    declared = row["contracts"]
+    unknown = set(declared) - set(CONTRACT_DEFAULTS)
+    if unknown:
+        raise ValueError(
+            f"engine {engine!r} declares unknown contract key(s): "
+            f"{', '.join(sorted(unknown))}"
+        )
+    spec = dict(CONTRACT_DEFAULTS)
+    spec.update(declared)
+    return spec
+
+
+def check_engine_metadata(caps: Optional[dict] = None) -> list[Violation]:
+    """The registration gate: every row carries well-formed contract
+    metadata. A new engine without it is named here, before any cell
+    runs."""
+    if caps is None:
+        from poisson_ellipse_tpu.solver.engine import ENGINE_CAPS
+
+        caps = ENGINE_CAPS
+    out: list[Violation] = []
+    for engine in caps:
+        try:
+            engine_contract_spec(engine, caps)
+        except ValueError as e:
+            out.append(Violation("engine-metadata", engine, str(e)))
+    return out
+
+
+# -- builders (the real product entry points, traced abstractly) -------------
+
+
+def _mesh(mesh_shape):
+    from poisson_ellipse_tpu.harness.run import resolve_mesh
+
+    return resolve_mesh(tuple(mesh_shape))
+
+
+def _build_single(problem: Problem, engine: str, dtype, **kw):
+    from poisson_ellipse_tpu.solver.engine import ENGINE_CAPS, build_solver
+
+    if ENGINE_CAPS[engine]["family"] == "batched":
+        kw.setdefault("lanes", 2)
+    solver, args, _ = build_solver(problem, engine, dtype, **kw)
+    return solver, args
+
+
+def _build_sharded(problem: Problem, engine: str, dtype, mesh_shape,
+                   sstep_s: int = 4):
+    from poisson_ellipse_tpu.obs.static_cost import _build
+
+    return _build(problem, engine, dtype, "sharded", tuple(mesh_shape),
+                  sstep_s=sstep_s)
+
+
+def _abstract_state(init_fn):
+    # the pins read the JAXPR only — eval_shape keeps the stepper state
+    # abstract, so nothing is compiled or dispatched to shape the trace
+    return jax.eval_shape(init_fn)
+
+
+def _build_stepper(problem: Problem, engine: str, dtype, mesh, abft: bool,
+                   sstep_s: int = 4):
+    if engine in ("xla", "pallas", "fused"):
+        from poisson_ellipse_tpu.parallel.pcg_sharded import (
+            build_sharded_stepper,
+        )
+
+        return build_sharded_stepper(problem, mesh, dtype, abft=abft)
+    if engine == "pipelined":
+        from poisson_ellipse_tpu.parallel.pipelined_sharded import (
+            build_pipelined_sharded_stepper,
+        )
+
+        return build_pipelined_sharded_stepper(problem, mesh, dtype, abft=abft)
+    if engine in ("mg-pcg", "cheb-pcg"):
+        from poisson_ellipse_tpu.parallel.mg_sharded import (
+            build_mg_sharded_stepper,
+        )
+        from poisson_ellipse_tpu.solver.engine import PRECOND_KIND_BY_ENGINE
+
+        init, adv, _rec = build_mg_sharded_stepper(
+            problem, mesh, dtype, kind=PRECOND_KIND_BY_ENGINE[engine],
+            abft=abft,
+        )
+        return init, adv
+    if engine == "sstep":
+        from poisson_ellipse_tpu.parallel.sstep_sharded import (
+            build_sstep_sharded_stepper,
+        )
+
+        return build_sstep_sharded_stepper(problem, mesh, dtype, s=sstep_s,
+                                           abft=abft)
+    raise ValueError(f"engine {engine!r} has no sharded stepper form")
+
+
+def _precond_halos(problem: Problem, engine: str) -> int:
+    """Halo exchanges per body for the preconditioned loops: the fine
+    stencil + the V-cycle/Chebyshev budget — exactly the expression the
+    hand-written pins used."""
+    from poisson_ellipse_tpu.mg.engine import default_config
+    from poisson_ellipse_tpu.parallel.mg_sharded import halos_per_precond
+
+    if engine == "fmg":
+        from poisson_ellipse_tpu.mg import coarsen
+
+        return 1 + halos_per_precond(coarsen.num_levels(problem.M, problem.N))
+    kind = {"mg-pcg": "mg", "cheb-pcg": "cheb"}[engine]
+    cfg = default_config(problem, kind)
+    return 1 + halos_per_precond(
+        cfg.levels,
+        cfg.nu,
+        cfg.coarse_degree if kind == "mg" else cfg.cheb_degree,
+    )
+
+
+# -- the contract checks -----------------------------------------------------
+
+
+def _result(kind, engine, expected, actual, messages) -> ContractResult:
+    violations = tuple(Violation(kind, engine, m) for m in messages)
+    return ContractResult(
+        kind=kind,
+        engine=engine,
+        status="fail" if violations else "pass",
+        expected=expected,
+        actual=actual,
+        violations=violations,
+    )
+
+
+def _check_single_collective_free(engine, spec, problem, dtype, **_):
+    solver, args = _build_single(problem, engine, dtype)
+    counts = jaxpr_scan.count_primitives(
+        jaxpr_scan.trace(solver, args).jaxpr, jaxpr_scan.COLLECTIVE_PRIMS
+    )
+    total = {k: v for k, v in counts.items() if v}
+    msgs = (
+        [f"single-chip trace holds collectives: {total}"] if total else []
+    )
+    return _result(
+        "single-collective-free", engine, {}, total, msgs
+    )
+
+
+def _cadence_expected(engine, spec, problem, sstep_s):
+    """(psum, ppermute-or-None) per sharded while body, derived from the
+    contracts row — the exact values the hand pins asserted."""
+    psum = spec["sharded_psum"]
+    halo = spec["sharded_halo"]
+    if halo is None:
+        return psum, None
+    if halo == "precond":
+        return psum, 4 * _precond_halos(problem, engine)
+    return psum, 4 * int(halo)
+
+
+def _check_collective_cadence(engine, spec, problem, dtype, mesh_shape,
+                              sstep_s=4, expect=None, **_):
+    solver, args = _build_sharded(problem, engine, dtype, mesh_shape,
+                                  sstep_s=sstep_s)
+    counts = jaxpr_scan.loop_primitive_counts(solver, args)
+    psum = counts.get("psum", 0) + counts.get("psum_invariant", 0)
+    ppermute = counts.get("ppermute", 0)
+    want_psum, want_pp = (
+        expect if expect is not None
+        else _cadence_expected(engine, spec, problem, sstep_s)
+    )
+    msgs = []
+    if psum != want_psum:
+        msgs.append(
+            f"sharded while body holds {psum} psum(s), contract says "
+            f"{want_psum} (counts: {counts})"
+        )
+    if want_pp is not None and ppermute != want_pp:
+        msgs.append(
+            f"sharded while body holds {ppermute} ppermute(s), contract "
+            f"says {want_pp} (counts: {counts})"
+        )
+    return _result(
+        "collective-cadence", engine,
+        {"psum": want_psum, "ppermute": want_pp},
+        {"psum": psum, "ppermute": ppermute}, msgs,
+    )
+
+
+def _check_batched_cadence(engine, spec, problem, dtype, mesh_shape,
+                           lanes=4, expect=None, **_):
+    from poisson_ellipse_tpu.parallel.batched_sharded import (
+        build_batched_sharded_solver,
+    )
+
+    mesh = _mesh(mesh_shape)
+    solver, args = build_batched_sharded_solver(
+        problem, mesh, lanes=lanes, dtype=dtype,
+        pipelined=(engine == "batched-pipelined"),
+    )
+    counts = jaxpr_scan.loop_primitive_counts(solver, args)
+    psum = counts.get("psum", 0) + counts.get("psum_invariant", 0)
+    ppermute = counts.get("ppermute", 0)
+    want_psum, want_pp = (
+        expect if expect is not None
+        else (spec["batched_psum"], 4 * int(spec["batched_halo"]))
+    )
+    msgs = []
+    if psum != want_psum:
+        msgs.append(
+            f"lane-sharded while body holds {psum} psum(s), contract "
+            f"says {want_psum} (counts: {counts})"
+        )
+    if ppermute != want_pp:
+        msgs.append(
+            f"lane-sharded while body holds {ppermute} ppermute(s), "
+            f"contract says {want_pp} (counts: {counts})"
+        )
+    return _result(
+        "batched-cadence", engine,
+        {"psum": want_psum, "ppermute": want_pp},
+        {"psum": psum, "ppermute": ppermute}, msgs,
+    )
+
+
+def _check_abft_identity(engine, spec, problem, dtype, mesh_shape,
+                         sstep_s=4, **_):
+    mesh = _mesh(mesh_shape)
+    per_flag = {}
+    for flag in (False, True):
+        init_fn, advance_fn = _build_stepper(problem, engine, dtype, mesh,
+                                             abft=flag, sstep_s=sstep_s)
+        state = _abstract_state(init_fn)
+        per_flag[flag] = jaxpr_scan.loop_collectives(advance_fn, (state, 10))
+    msgs = []
+    if per_flag[True] != per_flag[False]:
+        msgs.append(
+            f"ABFT changes the per-body collectives: off={per_flag[False]} "
+            f"on={per_flag[True]}"
+        )
+    want_psum = spec["sharded_psum"]
+    if want_psum is not None and per_flag[True][0] != want_psum:
+        msgs.append(
+            f"ABFT stepper body holds {per_flag[True][0]} psum(s), "
+            f"contract says {want_psum}"
+        )
+    return _result(
+        "abft-identity", engine,
+        {"off==on": True, "psum": want_psum},
+        {"off": per_flag[False], "on": per_flag[True]}, msgs,
+    )
+
+
+def _check_guard_overhead(engine, spec, problem, dtype, **_):
+    from poisson_ellipse_tpu.resilience.guard import (
+        _ClassicalAdapter,
+        _PipelinedAdapter,
+    )
+
+    lim = jax.ShapeDtypeStruct((), jnp.int32)
+    if spec["guard"] == "classical":
+        from poisson_ellipse_tpu.solver.pcg import advance as plain_advance
+
+        adapter = _ClassicalAdapter(problem, dtype)
+    else:
+        from poisson_ellipse_tpu.ops.pipelined_pcg import (
+            advance as plain_advance,
+        )
+
+        adapter = _PipelinedAdapter(problem, dtype)
+    a, b, rhs = adapter._operands
+    state = _abstract_state(adapter.init)
+    guarded = jaxpr_scan.trace_text(adapter.advance_fn, (state, lim))
+    plain = jaxpr_scan.trace_text(
+        lambda s, l: plain_advance(problem, a, b, rhs, s, limit=l),
+        (state, lim),
+    )
+    msgs = (
+        []
+        if guarded == plain
+        else [
+            "guard adapter advance jaxpr differs from the unguarded "
+            "advance (zero-overhead-when-healthy broken)"
+        ]
+    )
+    return _result(
+        "guard-overhead", engine, {"identical": True},
+        {"identical": guarded == plain}, msgs,
+    )
+
+
+def _storage_pair(engine, problem, dtype):
+    """(default trace, storage_dtype=None trace) through the ops-level
+    recurrence — the byte-identity the storage axis promised."""
+    from poisson_ellipse_tpu.ops import assembly
+
+    a, b, rhs = assembly.assemble(problem, dtype)
+    if engine == "pipelined":
+        from poisson_ellipse_tpu.ops.pipelined_pcg import pcg_pipelined as fn
+    else:
+        from poisson_ellipse_tpu.solver.pcg import pcg as fn
+    base = jaxpr_scan.trace_text(lambda *o: fn(problem, *o), (a, b, rhs))
+    none = jaxpr_scan.trace_text(
+        lambda *o: fn(problem, *o, storage_dtype=None), (a, b, rhs)
+    )
+    return base, none
+
+
+def _check_storage_identity(engine, spec, problem, dtype, **_):
+    base, none = _storage_pair(engine, problem, dtype)
+    msgs = (
+        []
+        if base == none
+        else [
+            "storage_dtype=None traces a different jaxpr than the "
+            "pre-storage path (the free-when-off axis regressed)"
+        ]
+    )
+    return _result(
+        "storage-identity", engine, {"identical": True},
+        {"identical": base == none}, msgs,
+    )
+
+
+def _check_storage_narrow(engine, spec, problem, dtype, sstep_s=4, **_):
+    solver, args = _build_single(problem, engine, dtype,
+                                 storage_dtype="bf16")
+    closed = jaxpr_scan.trace(solver, args)
+    bodies = jaxpr_scan.while_bodies(closed.jaxpr)
+    pairs = [p for body in bodies for p in
+             jaxpr_scan.convert_dtype_pairs(body)]
+    widens = any(src == "bfloat16" and dst != "bfloat16"
+                 for src, dst in pairs)
+    narrows = any(dst == "bfloat16" and src != "bfloat16"
+                  for src, dst in pairs)
+    msgs = []
+    if not widens:
+        msgs.append(
+            "bf16-storage loop body never widens a narrow value — the "
+            "compute path is running at storage width"
+        )
+    if not narrows:
+        msgs.append(
+            "bf16-storage loop body never narrows back to storage — the "
+            "state is being carried at full width (no bandwidth cut)"
+        )
+    return _result(
+        "storage-narrow", engine, {"widens": True, "narrows": True},
+        {"widens": widens, "narrows": narrows}, msgs,
+    )
+
+
+def _check_history_free(engine, spec, problem, dtype, **_):
+    solver_default, args = _build_single(problem, engine, dtype)
+    solver_off, _ = _build_single(problem, engine, dtype, history=False)
+    base = jaxpr_scan.trace_text(solver_default, args)
+    off = jaxpr_scan.trace_text(solver_off, args)
+    msgs = []
+    if base != off:
+        msgs.append(
+            "history=False traces a different jaxpr than the default "
+            "build — the telemetry axis is not free when off"
+        )
+    if "dynamic_update_slice" in base:
+        msgs.append(
+            "the default (history-off) trace contains "
+            "dynamic_update_slice — recording leaked into the hot path"
+        )
+    want_outvars = _HISTORY_OUTVARS.get(engine)
+    got_whiles, got_outvars = None, None
+    if want_outvars is not None:
+        bodies = jaxpr_scan.while_bodies(
+            jaxpr_scan.trace(solver_default, args).jaxpr
+        )
+        got_whiles = len(bodies)
+        if got_whiles != 1:
+            msgs.append(
+                f"expected exactly 1 while loop in the default trace, "
+                f"found {got_whiles}"
+            )
+        else:
+            got_outvars = len(bodies[0].outvars)
+            if got_outvars != want_outvars:
+                msgs.append(
+                    f"history-off carry widened: {got_outvars} outvars, "
+                    f"contract says {want_outvars}"
+                )
+    return _result(
+        "history-free", engine,
+        {"identical": True, "dus": False, "outvars": want_outvars},
+        {"identical": base == off, "dus": "dynamic_update_slice" in base,
+         "outvars": got_outvars}, msgs,
+    )
+
+
+def _check_history_resident(engine, spec, problem, dtype, **_):
+    solver, args = _build_single(problem, engine, dtype, history=True)
+    text = jaxpr_scan.trace_text(solver, args)
+    msgs = []
+    if "dynamic_update_slice" not in text:
+        msgs.append(
+            "history=True trace holds no dynamic_update_slice — the "
+            "on-device recording buffers are gone"
+        )
+    for host_prim in ("callback", "device_get"):
+        if host_prim in text:
+            msgs.append(
+                f"history=True trace contains {host_prim!r} — telemetry "
+                "must stay device-resident (zero host syncs)"
+            )
+    return _result(
+        "history-resident", engine,
+        {"dus": True, "callbacks": False},
+        {"dus": "dynamic_update_slice" in text,
+         "callbacks": any(p in text for p in ("callback", "device_get"))},
+        msgs,
+    )
+
+
+def _check_fcycle_budget(engine, spec, problem, dtype, mesh_shape, **_):
+    from poisson_ellipse_tpu.mg import coarsen
+    from poisson_ellipse_tpu.mg.fmg import DEFAULT_FMG_VCYCLES
+    from poisson_ellipse_tpu.parallel.mg_sharded import (
+        halos_per_fcycle,
+        halos_per_precond,
+    )
+
+    solver, args = _build_sharded(problem, engine, dtype, mesh_shape)
+    closed = jaxpr_scan.trace(solver, args)
+    total = jaxpr_scan.count_primitives(closed.jaxpr, ("ppermute",))
+    levels = coarsen.num_levels(problem.M, problem.N)
+    fcycle = halos_per_fcycle(levels, n_vcycles=DEFAULT_FMG_VCYCLES)
+    per_loop = 1 + halos_per_precond(levels)
+    # budget: levels' (a, b) coefficient extensions (two exchanges per
+    # level, once per dispatch), ONE F-cycle, init's precond+stencil,
+    # and the handoff-loop body — exactly the hand pin's expression
+    want = 4 * (2 * levels + fcycle + 2 * per_loop)
+    got = total["ppermute"]
+    msgs = (
+        []
+        if got == want
+        else [
+            f"whole-trace ppermute total {got} != budget {want} "
+            f"(levels={levels}, fcycle={fcycle}) — a hidden exchange"
+        ]
+    )
+    return _result(
+        "fcycle-budget", engine, {"ppermute_total": want},
+        {"ppermute_total": got}, msgs,
+    )
+
+
+_CHECKERS = {
+    "single-collective-free": _check_single_collective_free,
+    "collective-cadence": _check_collective_cadence,
+    "batched-cadence": _check_batched_cadence,
+    "abft-identity": _check_abft_identity,
+    "guard-overhead": _check_guard_overhead,
+    "storage-identity": _check_storage_identity,
+    "storage-narrow": _check_storage_narrow,
+    "history-free": _check_history_free,
+    "history-resident": _check_history_resident,
+    "fcycle-budget": _check_fcycle_budget,
+}
+
+
+def contract_applies(kind: str, engine: str,
+                     caps: Optional[dict] = None) -> bool:
+    """Whether ``kind`` is declared for ``engine`` — the applicability
+    the matrix enumerates (a cell that does not apply is skipped with a
+    reason, not silently dropped)."""
+    spec = engine_contract_spec(engine, caps)
+    return {
+        "engine-metadata": True,
+        "single-collective-free": spec["single_collective_free"],
+        "collective-cadence": spec["sharded_psum"] is not None,
+        "batched-cadence": spec["batched_psum"] is not None,
+        "abft-identity": spec["abft"],
+        "guard-overhead": spec["guard"] is not None,
+        "storage-identity": spec["storage_identity"],
+        "storage-narrow": spec["storage_narrow"],
+        "history-free": spec["history_resident"],
+        "history-resident": spec["history_resident"],
+        "fcycle-budget": spec["fcycle_budget"],
+    }[kind]
+
+
+def default_problem(engine: str) -> Problem:
+    """The tiny trace grid: 16×16 everywhere (the fmg pin's size; counts
+    are grid-independent, budgets are derived per grid)."""
+    del engine
+    return Problem(M=16, N=16)
+
+
+def check_contract(
+    kind: str,
+    engine: str,
+    *,
+    problem: Optional[Problem] = None,
+    dtype=jnp.float32,
+    mesh_shape: tuple[int, int] = (1, 2),
+    expect=None,
+    **kw,
+) -> ContractResult:
+    """Run one contract at one cell; returns the :class:`ContractResult`.
+
+    ``expect`` overrides the ENGINE_CAPS-derived expected values (the
+    injected-violation fixtures use it to prove a contract fires); the
+    product path always derives from the capability table.
+    """
+    if kind not in CONTRACT_KINDS:
+        raise ValueError(
+            f"unknown contract kind {kind!r} "
+            f"(known: {', '.join(sorted(CONTRACT_KINDS))})"
+        )
+    if kind == "engine-metadata":
+        violations = tuple(check_engine_metadata())
+        return ContractResult(
+            kind=kind, engine=engine,
+            status="fail" if violations else "pass",
+            violations=violations,
+        )
+    spec = engine_contract_spec(engine)
+    if not contract_applies(kind, engine):
+        raise ValueError(
+            f"contract {kind!r} does not apply to engine {engine!r} "
+            "(not declared in its ENGINE_CAPS contracts row)"
+        )
+    if problem is None:
+        problem = default_problem(engine)
+    return _CHECKERS[kind](
+        engine, spec, problem, dtype, mesh_shape=mesh_shape, expect=expect,
+        **kw,
+    )
+
+
+def assert_contract(kind: str, engine: str, **kw) -> ContractResult:
+    """The one-line test form: raise ``AssertionError`` naming every
+    violation; return the result for callers that also want the facts."""
+    result = check_contract(kind, engine, **kw)
+    if result.violations:
+        raise AssertionError(
+            "; ".join(v.render() for v in result.violations)
+        )
+    return result

@@ -1,0 +1,143 @@
+"""Jaxpr traversal: the one walk every structural pin reads through.
+
+Every performance guarantee this framework advertises is *structural* —
+1 stacked psum per pipelined iteration, a 4-``ppermute`` halo ring, no
+``dynamic_update_slice`` when history is off, byte-identical jaxprs
+across axes that claim to be free. Those facts live in the traced
+computation, and this module is the single reader: ``jax.make_jaxpr``
+based (abstract tracing only — no compiles, no devices), recursing into
+every sub-jaxpr an equation carries (``while``/``cond``/``scan``/
+``pjit``/``custom_*``/Pallas kernels alike, via the params walk).
+
+``obs.static_cost`` consumes these primitives for its per-engine cost
+reports, and ``analysis.contracts`` consumes them for the declarative
+contract matrix — one traversal, two read paths, zero drift.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# the collective primitives worth budgeting on a TPU mesh
+# (psum_invariant is newer-jax spelling riding the same wire as psum)
+COLLECTIVE_PRIMS = (
+    "psum",
+    "psum_invariant",
+    "ppermute",
+    "all_gather",
+    "reduce_scatter",
+    "all_to_all",
+)
+
+
+def subjaxprs(eqn):
+    """Every sub-jaxpr hanging off one equation's params.
+
+    Covers ``while`` (``cond_jaxpr``/``body_jaxpr``), ``cond``
+    (``branches``), ``scan``/``pjit``/``closed_call`` (``jaxpr``),
+    ``custom_jvp``/``custom_vjp`` and ``pallas_call`` — anything whose
+    params hold an object with ``.eqns`` (open jaxpr) or ``.jaxpr.eqns``
+    (closed jaxpr), scalar or in a list/tuple.
+    """
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        for x in vals:
+            if hasattr(x, "eqns"):
+                yield x
+            elif hasattr(x, "jaxpr") and hasattr(x.jaxpr, "eqns"):
+                yield x.jaxpr
+
+
+def walk_eqns(jaxpr):
+    """Every equation in ``jaxpr``, recursively (depth-first, document
+    order), including those inside sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in subjaxprs(eqn):
+            yield from walk_eqns(sub)
+
+
+def count_primitives(jaxpr, names: tuple[str, ...]) -> dict[str, int]:
+    """Occurrences of each named primitive in ``jaxpr``, recursively."""
+    counts = {name: 0 for name in names}
+    for eqn in walk_eqns(jaxpr):
+        if eqn.primitive.name in counts:
+            counts[eqn.primitive.name] += 1
+    return counts
+
+
+def while_bodies(jaxpr):
+    """Every ``while_loop`` body jaxpr in ``jaxpr`` (outermost-first),
+    found recursively — nested loops and loops inside ``cond`` branches
+    or ``pjit`` calls included."""
+    out = []
+    for eqn in walk_eqns(jaxpr):
+        if eqn.primitive.name == "while":
+            body = eqn.params["body_jaxpr"]
+            out.append(body.jaxpr if hasattr(body, "jaxpr") else body)
+    return out
+
+
+def trace(fn, args):
+    """``fn``'s closed jaxpr over abstract ``args`` — no compile, no
+    execution, no devices."""
+    return jax.make_jaxpr(fn)(*args)
+
+
+def trace_text(fn, args) -> str:
+    """The jaxpr's printed form — the byte-for-byte identity currency of
+    the structural-identity pins (``storage_dtype=None``, guarded vs
+    unguarded)."""
+    return str(trace(fn, args))
+
+
+def while_body_primitive_counts(fn, args, names: tuple[str, ...]) -> list[dict]:
+    """Primitive counts inside each ``while_loop`` body of ``fn``'s
+    jaxpr (one dict per loop, outermost-first)."""
+    closed = trace(fn, args)
+    return [count_primitives(body, names) for body in while_bodies(closed.jaxpr)]
+
+
+def loop_primitive_counts(
+    fn, args, names: tuple[str, ...] = COLLECTIVE_PRIMS
+) -> dict[str, int]:
+    """Per-iteration primitive counts: the sum over all while bodies.
+
+    The solvers hold exactly one hot ``while_loop``; summing keeps the
+    answer right if an engine ever splits its iteration across two.
+    """
+    merged = {name: 0 for name in names}
+    for body in while_body_primitive_counts(fn, args, names):
+        for name, n in body.items():
+            merged[name] += n
+    return merged
+
+
+def loop_collectives(fn, args) -> tuple[int, int]:
+    """(psum, ppermute) per while body, with the ``psum_invariant``
+    spelling folded into psum (one collective on the wire). The compact
+    pair every cadence pin compares."""
+    counts = loop_primitive_counts(fn, args)
+    return (
+        counts.get("psum", 0) + counts.get("psum_invariant", 0),
+        counts.get("ppermute", 0),
+    )
+
+
+def convert_dtype_pairs(jaxpr) -> list[tuple[str, str]]:
+    """(src, dst) dtype-name pairs of every ``convert_element_type`` in
+    ``jaxpr``, recursively — the storage-vs-compute seam reader: a
+    narrow-storage build must widen on the HBM-read side and narrow on
+    the store side; a full-width build must carry no narrow leg at all.
+    """
+    pairs: list[tuple[str, str]] = []
+    for eqn in walk_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        dst = eqn.params.get("new_dtype")
+        try:
+            src = eqn.invars[0].aval.dtype
+        except (AttributeError, IndexError):
+            continue
+        pairs.append((str(src), str(dst)))
+    return pairs

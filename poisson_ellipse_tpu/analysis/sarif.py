@@ -1,0 +1,119 @@
+"""Minimal SARIF 2.1.0 writer/reader shared by tpulint and the contract
+matrix CLI.
+
+SARIF is the one format both GitHub code scanning and most CI annotators
+ingest natively, so both static passes emit the same subset: one ``run``
+per tool, one ``result`` per finding with a physical location. The
+reader inverts exactly what the writer emits — the round-trip the tests
+pin — and deliberately nothing more (full SARIF is a spec, not a
+weekend).
+
+Pure stdlib on purpose: the linter never imports JAX, and this module is
+imported from the lint CLI.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Optional
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def sarif_result(
+    rule_id: str,
+    message: str,
+    *,
+    path: Optional[str] = None,
+    line: int = 1,
+    col: int = 1,
+    level: str = "error",
+) -> dict:
+    """One SARIF ``result`` object; ``path=None`` emits no location
+    (matrix cells have no source file — the cell id is the rule)."""
+    result: dict = {
+        "ruleId": rule_id,
+        "level": level,
+        "message": {"text": message},
+    }
+    if path is not None:
+        result["locations"] = [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {"uri": path},
+                    "region": {"startLine": line, "startColumn": col},
+                }
+            }
+        ]
+    return result
+
+
+def sarif_report(
+    tool_name: str,
+    results: Iterable[dict],
+    *,
+    rules: Optional[dict] = None,
+    information_uri: str = "",
+) -> dict:
+    """The SARIF document: one run, the given results. ``rules`` maps
+    rule id -> short description (the driver's rule table)."""
+    driver: dict = {"name": tool_name, "rules": []}
+    if information_uri:
+        driver["informationUri"] = information_uri
+    if rules:
+        driver["rules"] = [
+            {
+                "id": rule_id,
+                "shortDescription": {"text": text},
+            }
+            for rule_id, text in sorted(rules.items())
+        ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{"tool": {"driver": driver}, "results": list(results)}],
+    }
+
+
+def findings_to_sarif(findings, tool_name: str = "tpulint",
+                      rules: Optional[dict] = None) -> dict:
+    """tpulint ``Finding``s -> SARIF document."""
+    return sarif_report(
+        tool_name,
+        (
+            sarif_result(
+                f.code, f.message, path=f.path, line=f.line, col=f.col
+            )
+            for f in findings
+        ),
+        rules=rules,
+    )
+
+
+def sarif_findings(doc) -> list[tuple[str, str, int, int, str]]:
+    """Invert :func:`findings_to_sarif`: (path, code, line, col, message)
+    per result — the round-trip read the tests and baseline tooling use.
+    Accepts a parsed document or a JSON string."""
+    if isinstance(doc, str):
+        doc = json.loads(doc)
+    out = []
+    for run in doc.get("runs", []):
+        for result in run.get("results", []):
+            locs = result.get("locations") or [{}]
+            phys = locs[0].get("physicalLocation", {})
+            path = phys.get("artifactLocation", {}).get("uri", "")
+            region = phys.get("region", {})
+            out.append(
+                (
+                    path,
+                    result.get("ruleId", ""),
+                    int(region.get("startLine", 1)),
+                    int(region.get("startColumn", 1)),
+                    result.get("message", {}).get("text", ""),
+                )
+            )
+    return out

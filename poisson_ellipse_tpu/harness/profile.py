@@ -183,6 +183,10 @@ def profile_sharded(
         return apply_dinv(u_blk, d)
 
     def dot_step(u_blk, a_ext, b_ext):
+        # the probe times the collective ITSELF (t_dot's psum leg), so
+        # it must issue one raw — outside the parallel/ cadence budgets
+        # by design, never part of a pinned solver loop
+        # tpulint: disable=TPU020
         s = lax.psum(jnp.sum(u_blk * u_blk), (AXIS_X, AXIS_Y)) * h1 * h2
         # rescale to keep the chain alive and the magnitude bounded
         return u_blk * (s / jnp.where(s == 0.0, 1.0, s))

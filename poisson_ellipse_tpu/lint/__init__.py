@@ -8,7 +8,7 @@ caught by hand across five rewrites. tpulint catches them mechanically:
     python -m poisson_ellipse_tpu.lint              # paths from pyproject
     python -m poisson_ellipse_tpu.lint poisson_ellipse_tpu/ops --statistics
 
-Rules are TPU001–TPU019 (see :mod:`.rules`); any finding can be waived
+Rules are TPU001–TPU020 (see :mod:`.rules`); any finding can be waived
 in place with a trailing or preceding-line comment::
 
     x = jnp.zeros(n, jnp.float64)  # tpulint: disable=TPU001
@@ -18,26 +18,38 @@ is shared by this CLI and the pytest gate (``tests/test_lint_clean.py``),
 so "lints clean" means the same thing on a laptop and in CI.
 
 Public API: :func:`load_config`, :func:`lint_paths`, :func:`lint_file`,
-:func:`lint_source` (the test harness entry), and :data:`RULES`.
+:func:`lint_source` (the test harness entry), :data:`RULES`, plus the
+hygiene surfaces: :func:`audit_suppressions`/:func:`audit_paths` (stale
+``disable`` annotations) and :func:`apply_baseline` (accept-then-ratchet
+``--baseline`` files).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import fnmatch
+import json
 import os
 import re
 from typing import Iterable, Optional
 
 from poisson_ellipse_tpu.lint.report import Finding, ParseError
 from poisson_ellipse_tpu.lint.rules import RULES, LintConfig
-from poisson_ellipse_tpu.lint.visitor import Module
+from poisson_ellipse_tpu.lint.visitor import (
+    Module,
+    _iter_suppression_comments,
+)
 
 __all__ = [
+    "AUDIT_CODE",
     "Finding",
     "LintConfig",
     "ParseError",
     "RULES",
+    "apply_baseline",
+    "audit_paths",
+    "audit_suppressions",
+    "finding_key",
     "lint_file",
     "lint_paths",
     "lint_source",
@@ -172,6 +184,9 @@ def load_config(root: Optional[str] = None) -> LintConfig:
         tunable_fns=tuple(
             table.get("tunable-fns", cfg.tunable_fns)
         ),
+        collective_modules=tuple(
+            table.get("collective-modules", cfg.collective_modules)
+        ),
     )
 
 
@@ -269,3 +284,161 @@ def lint_paths(
         except OSError as e:
             errors.append(ParseError(path=path, message=str(e)))
     return sorted(findings), sorted(errors, key=lambda e: e.path)
+
+
+# -- suppression audit -------------------------------------------------------
+
+# The audit's pseudo-code: findings about the *annotations*, not the
+# linted source, so it lives outside RULES (select/ignore never touch
+# it) and stays hyphen-free so the suppression-comment grammar could
+# address it.
+AUDIT_CODE = "TPU000"
+
+
+def audit_suppressions(
+    source: str,
+    path: str = "<snippet>",
+    config: Optional[LintConfig] = None,
+) -> list[Finding]:
+    """Report ``# tpulint: disable=...`` annotations that suppress
+    nothing — the annotation ratchet.
+
+    Every active rule is re-run WITHOUT suppression filtering; a
+    disable code (or ``all``) whose covered line carries no matching
+    raw finding is stale: it reads as a waiver for a hazard that no
+    longer exists, and it would silently swallow the next genuine
+    finding that lands on that line. Codes whose rules are not active
+    under ``config`` (select/ignore/per-path) are skipped — the audit
+    cannot judge them; codes unknown to the registry are always flagged
+    (they never suppressed anything).
+    """
+    config = config or LintConfig()
+    module = Module(path, source)
+    active = list(_active_rules(config, _path_ignored_codes(path, config)))
+    active_codes = {r.code for r in active}
+    fired_by_line: dict[int, set[str]] = {}
+    for rule in active:
+        for f in rule.check(module, config):
+            fired_by_line.setdefault(f.line, set()).add(f.code.upper())
+    out: list[Finding] = []
+    for lineno, standalone, codes in _iter_suppression_comments(source):
+        covered = {lineno}
+        if standalone:  # standalone: covers the line below too
+            covered.add(lineno + 1)
+        fired: set[str] = set()
+        for n in covered:
+            fired |= fired_by_line.get(n, set())
+        for code in sorted(codes):
+            if code == "ALL":
+                if not fired:
+                    out.append(Finding(
+                        path=path, line=lineno, col=1, code=AUDIT_CODE,
+                        message="unused suppression: `disable=all` "
+                        "covers no finding — remove the annotation",
+                    ))
+                continue
+            if code not in RULES:
+                out.append(Finding(
+                    path=path, line=lineno, col=1, code=AUDIT_CODE,
+                    message=f"unused suppression: `disable={code}` names "
+                    "no registered rule — it has never suppressed "
+                    "anything (typo?)",
+                ))
+                continue
+            if code not in active_codes:
+                continue  # rule not running here: nothing to judge
+            if code not in fired:
+                out.append(Finding(
+                    path=path, line=lineno, col=1, code=AUDIT_CODE,
+                    message=f"unused suppression: `disable={code}` "
+                    "matches no finding on the line it covers — the "
+                    "hazard is gone; remove the annotation",
+                ))
+    return sorted(out)
+
+
+def audit_paths(
+    paths: Iterable[str],
+    config: Optional[LintConfig] = None,
+) -> tuple[list[Finding], list[ParseError]]:
+    """:func:`audit_suppressions` over files/trees — same walking,
+    exclusion and error contract as :func:`lint_paths`."""
+    config = config or LintConfig()
+    paths = list(paths)
+    findings: list[Finding] = []
+    errors: list[ParseError] = []
+    for path in paths:
+        if not os.path.exists(path):
+            errors.append(
+                ParseError(path=path, message="no such file or directory")
+            )
+    for path in _iter_py_files(paths, config):
+        try:
+            with open(path, encoding="utf-8") as f:
+                findings.extend(
+                    audit_suppressions(f.read(), path=path, config=config)
+                )
+        except (SyntaxError, ValueError, UnicodeDecodeError, OSError) as e:
+            errors.append(ParseError(path=path, message=str(e)))
+    return sorted(findings), sorted(errors, key=lambda e: e.path)
+
+
+# -- baseline (accept-then-ratchet) ------------------------------------------
+
+
+def finding_key(f: Finding) -> str:
+    """The baseline identity of a finding — deliberately message-free,
+    so rewording a rule does not re-open accepted debt."""
+    return f"{f.path}:{f.line}:{f.code}"
+
+
+def apply_baseline(
+    baseline_path: str,
+    findings: list[Finding],
+    errors: list[ParseError],
+) -> tuple[list[Finding], Optional[str]]:
+    """Accept-then-ratchet: filter ``findings`` through a baseline file.
+
+    Missing file: every current finding is accepted into a fresh
+    baseline and the run reads clean — the adoption step. Existing
+    file: accepted keys stay silent, anything new fails; and once a run
+    is otherwise clean, accepted keys that no longer match a finding
+    are ratcheted OUT of the file, so the debt can only shrink. Returns
+    ``(new_findings, note)`` — the note narrates what the baseline did.
+    """
+    keys = sorted({finding_key(f) for f in findings})
+    if not os.path.exists(baseline_path):
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            json.dump(
+                {"tool": "tpulint", "version": 1, "accepted": keys},
+                fh, indent=2,
+            )
+            fh.write("\n")
+        return [], (
+            f"baseline: accepted {len(keys)} finding(s) into "
+            f"{baseline_path}"
+        )
+    with open(baseline_path, encoding="utf-8") as fh:
+        accepted = set(json.load(fh).get("accepted", []))
+    new = [f for f in findings if finding_key(f) not in accepted]
+    stale = sorted(accepted - set(keys))
+    if not stale:
+        return new, None
+    if new or errors:
+        return new, (
+            f"baseline: {len(stale)} stale entr"
+            f"{'y' if len(stale) == 1 else 'ies'} (ratchet deferred "
+            "until the run is clean)"
+        )
+    kept = sorted(accepted & set(keys))
+    with open(baseline_path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {"tool": "tpulint", "version": 1, "accepted": kept},
+            fh, indent=2,
+        )
+        fh.write("\n")
+    return new, (
+        f"baseline: ratcheted {len(stale)} fixed entr"
+        f"{'y' if len(stale) == 1 else 'ies'} out of {baseline_path} "
+        f"({len(kept)} remain)"
+    )

@@ -2,16 +2,26 @@
 
 Exit status: 0 clean, 1 findings, 2 unparseable input or bad usage —
 the same contract as the pytest gate, so CI needs no extra wiring.
+
+Beyond the flake8-style text report: ``--format sarif`` emits the same
+SARIF 2.1.0 subset as the contract matrix (one writer,
+``analysis.sarif``); ``--baseline FILE`` adopts existing debt then
+ratchets it down; ``--audit-suppressions`` reports stale
+``# tpulint: disable`` annotations instead of lint findings.
 """
 
 from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import sys
 
 from poisson_ellipse_tpu.lint import (
+    AUDIT_CODE,
     RULES,
+    apply_baseline,
+    audit_paths,
     lint_paths,
     load_config,
 )
@@ -34,7 +44,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m poisson_ellipse_tpu.lint",
         description="TPU-aware static analysis for the kernel zoo "
-        "(rules TPU001-TPU013; suppress with `# tpulint: disable=CODE`).",
+        "(rules TPU001-TPU020; suppress with `# tpulint: disable=CODE`).",
     )
     parser.add_argument(
         "paths",
@@ -57,6 +67,22 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true",
         help="print the rule table and exit",
     )
+    parser.add_argument(
+        "--format", choices=("text", "sarif"), default="text",
+        help="report format (sarif: the same 2.1.0 subset the contract "
+        "matrix emits)",
+    )
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="accept-then-ratchet: a missing FILE swallows today's "
+        "findings and is written; an existing one silences accepted "
+        "keys, fails anything new, and sheds fixed entries once clean",
+    )
+    parser.add_argument(
+        "--audit-suppressions", action="store_true",
+        help="report stale `# tpulint: disable` annotations "
+        f"({AUDIT_CODE}) instead of lint findings",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -72,14 +98,35 @@ def main(argv=None) -> int:
             config, ignore=config.ignore | args.ignore
         )
     paths = args.paths or list(config.paths)
-    findings, errors = lint_paths(paths, config)
+    runner = audit_paths if args.audit_suppressions else lint_paths
+    findings, errors = runner(paths, config)
     for err in errors:
         print(err.render(), file=sys.stderr)
-    if findings:
+    note = None
+    if args.baseline:
+        findings, note = apply_baseline(args.baseline, findings, errors)
+    if args.format == "sarif":
+        from poisson_ellipse_tpu.analysis.sarif import findings_to_sarif
+
+        rules = {code: r.summary for code, r in sorted(RULES.items())}
+        if args.audit_suppressions:
+            rules = {AUDIT_CODE: "unused-suppression: a disable "
+                     "annotation that suppresses nothing"}
+        print(json.dumps(
+            findings_to_sarif(findings, rules=rules), indent=2,
+            sort_keys=True,
+        ))
+    elif findings:
         print(render_report(findings, statistics=args.statistics))
     rc = exit_code(findings, errors)
-    if rc == 0:
-        print(f"tpulint: {len(list(RULES))} rules, 0 findings — clean")
+    if rc == 0 and args.format != "sarif":
+        what = (
+            "0 stale suppressions" if args.audit_suppressions
+            else f"{len(list(RULES))} rules, 0 findings"
+        )
+        print(f"tpulint: {what} — clean")
+    if note:
+        print(note, file=sys.stderr)
     return rc
 
 

@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU019.
+"""The tpulint rule registry: TPU001–TPU020.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -87,6 +87,14 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | overrule it; route the value through the      |
 |        |                    | engine-capability table, a named constant, or |
 |        |                    | the tuned-config registry                     |
+| TPU020 | raw-collective     | a raw jax.lax collective (psum / ppermute /   |
+|        |                    | all_gather / ...) issued outside the blessed  |
+|        |                    | communication modules (`collective-modules`,  |
+|        |                    | default parallel/) — the contract matrix's    |
+|        |                    | cadence budgets (analysis/, ENGINE_CAPS) only |
+|        |                    | sweep that layer, so a stray collective       |
+|        |                    | drifts the count invisibly; deliberate        |
+|        |                    | exceptions carry a justified disable          |
 """
 
 from __future__ import annotations
@@ -186,6 +194,14 @@ class LintConfig:
         "build_solver", "build_*_solver", "build_*_stepper",
         "make_precond", "make_vcycle", "make_fcycle", "guarded_solve",
         "solve_batched", "pcg_sstep", "resolve_fmg_config",
+    )
+    # TPU020: the modules licensed to issue raw jax.lax collectives
+    # ("/"-normalized path fnmatch patterns). Every cadence the contract
+    # matrix (analysis/) pins — psums per body, halo ppermute budgets —
+    # is counted over the communication layer; a collective issued
+    # outside it is invisible to those budgets until it breaks one.
+    collective_modules: tuple[str, ...] = (
+        "*/parallel/*", "parallel/*",
     )
 
 
@@ -2711,4 +2727,66 @@ def check_hardcoded_tunable(module: Module,
                 "overrule it. Route the value through a named "
                 "constant, the table's tunables row, or the tuned-"
                 "config registry",
+            )
+
+
+# --------------------------------------------------------------------------
+# TPU020 — raw collectives outside the blessed communication modules
+# --------------------------------------------------------------------------
+
+_COLLECTIVE_FNS = frozenset({
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.ppermute", "jax.lax.pshuffle", "jax.lax.psum_scatter",
+    "jax.lax.all_gather", "jax.lax.all_to_all",
+})
+
+
+@rule(
+    "TPU020",
+    "raw-collective",
+    "a raw jax.lax collective issued outside the blessed communication "
+    "modules (`collective-modules`) — the contract matrix's cadence "
+    "budgets cannot account for it",
+)
+def check_raw_collective(module: Module,
+                         config: LintConfig) -> Iterator[Finding]:
+    """The communication-layer fence. The engine zoo's collective
+    cadences — 2 psums per classical body, ONE per pipelined body, the
+    ``halos_per_precond`` ppermute budgets — are declared in
+    ``ENGINE_CAPS`` and pinned by the contract matrix (``analysis/``)
+    over the builders in ``parallel/``. A ``lax.psum``/``lax.ppermute``
+    issued from any other module joins a traced computation those
+    budgets never swept: the count drifts, the matrix stays green, and
+    the regression surfaces as a multichip perf mystery instead of a
+    lint line.
+
+    ``collective-modules`` (path fnmatch patterns) names the licensed
+    layer — ``parallel/`` by default. Deliberate exceptions (a
+    bandwidth probe measuring the collective itself) carry a
+    ``# tpulint: disable=TPU020`` with the justification. Anonymous
+    sources (``<snippet>``) are skipped: a path-classified rule cannot
+    place them in a layer.
+    """
+    if module.path == "<snippet>":
+        return
+    norm_path = module.path.replace(os.sep, "/")
+    if any(
+        fnmatch.fnmatch(norm_path, pat)
+        for pat in config.collective_modules
+    ):
+        return
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = module.qualname(node.func)
+        if q in _COLLECTIVE_FNS:
+            yield _finding(
+                module,
+                node,
+                "TPU020",
+                f"raw `{q.removeprefix('jax.')}` outside the "
+                "communication layer — the contract matrix's cadence "
+                "budgets (analysis/, ENGINE_CAPS) only sweep "
+                "`collective-modules`; route the exchange through "
+                "parallel/ or annotate the deliberate exception",
             )

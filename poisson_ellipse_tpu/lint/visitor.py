@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import re
+import tokenize
 from typing import Iterator, Optional
 
 # Attribute reads that produce Python-static facts even on a traced
@@ -38,6 +40,39 @@ TRACED_CALLABLE_ARGS = {
 }
 
 
+def _iter_suppression_comments(
+    source: str,
+) -> Iterator[tuple[int, bool, frozenset[str]]]:
+    """Yield ``(lineno, standalone, codes)`` for every real
+    ``# tpulint: disable=`` COMMENT token.
+
+    Tokenising (not line-scanning) means docstrings, help strings, and
+    test fixtures that merely *mention* the annotation syntax are never
+    treated as live suppressions — and never audited as stale ones.
+    """
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            codes = frozenset(
+                c.strip().upper()
+                for c in m.group(1).split(",")
+                if c.strip()
+            )
+            if not codes:
+                continue
+            standalone = tok.line[: tok.start[1]].strip() == ""
+            yield tok.start[0], standalone, codes
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # the caller already ast-parsed this source, so a tokenizer
+        # failure is a stdlib edge case: no comments beats a crash
+        return
+
+
 def _parse_suppressions(source: str) -> dict[int, frozenset[str]]:
     """line number -> codes disabled on that line.
 
@@ -47,13 +82,9 @@ def _parse_suppressions(source: str) -> dict[int, frozenset[str]]:
     ``disable=all`` disables every rule.
     """
     out: dict[int, set[str]] = {}
-    for lineno, text in enumerate(source.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(text)
-        if not m:
-            continue
-        codes = {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+    for lineno, standalone, codes in _iter_suppression_comments(source):
         out.setdefault(lineno, set()).update(codes)
-        if text.strip().startswith("#"):  # standalone: covers the line below
+        if standalone:  # standalone: covers the line below too
             out.setdefault(lineno + 1, set()).update(codes)
     return {k: frozenset(v) for k, v in out.items()}
 

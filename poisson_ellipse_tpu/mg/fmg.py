@@ -273,5 +273,5 @@ def fmg_initial_guess(problem: Problem, dtype=jnp.float32, geometry=None,
 
     # single-shot by design: the prelude runs once per guarded build and
     # the operands are re-fed to the chunked adapter afterwards
-    x0 = jax.jit(fcycle)(a, b, rhs)  # tpulint: disable=TPU004,TPU006
+    x0 = jax.jit(fcycle)(a, b, rhs)  # tpulint: disable=TPU006
     return x0, (a, b, rhs), cfg

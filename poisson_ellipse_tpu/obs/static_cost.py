@@ -36,21 +36,20 @@ import jax.numpy as jnp
 
 from poisson_ellipse_tpu.models.problem import Problem
 
-# the collective primitives worth budgeting on a TPU mesh (psum_invariant
-# is newer-jax spelling riding the same wire as psum)
-COLLECTIVE_PRIMS = (
-    "psum",
-    "psum_invariant",
-    "ppermute",
-    "all_gather",
-    "reduce_scatter",
-    "all_to_all",
+# the jaxpr walk lives in analysis.jaxpr_scan (the contract matrix and
+# this report read the SAME traversal); re-exported here because every
+# cadence pin historically imports them from obs.static_cost
+from poisson_ellipse_tpu.analysis.jaxpr_scan import (  # noqa: F401
+    COLLECTIVE_PRIMS,
+    count_primitives,
+    loop_collectives,
+    loop_primitive_counts,
+    while_body_primitive_counts,
 )
 
-SHARDED_ENGINES = (
-    "xla", "pallas", "fused", "pipelined", "mg-pcg", "cheb-pcg", "sstep",
-    "fmg",
-)
+# derived from the ENGINE_CAPS contract metadata — an engine declares a
+# sharded collective cadence iff it has a sharded form
+from poisson_ellipse_tpu.solver.engine import SHARDED_ENGINES  # noqa: F401
 
 # iterations advanced per while-loop body: the s-step engines run s
 # iterations per body (matrix-powers block), every other engine runs 1.
@@ -58,86 +57,6 @@ SHARDED_ENGINES = (
 # per-ITERATION figures — the denominator every cadence claim uses.
 def iters_per_loop_body(engine: str, sstep_s: int = 4) -> int:
     return sstep_s if engine in ("sstep", "sstep-pallas") else 1
-
-
-# -- jaxpr walking -----------------------------------------------------------
-
-
-def _subjaxprs(eqn):
-    """Every sub-jaxpr hanging off one equation's params."""
-    for v in eqn.params.values():
-        vals = v if isinstance(v, (list, tuple)) else [v]
-        for x in vals:
-            if hasattr(x, "eqns"):
-                yield x
-            elif hasattr(x, "jaxpr") and hasattr(x.jaxpr, "eqns"):
-                yield x.jaxpr
-
-
-def count_primitives(jaxpr, names: tuple[str, ...]) -> dict[str, int]:
-    """Occurrences of each named primitive in ``jaxpr``, recursively."""
-    counts = {name: 0 for name in names}
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            if eqn.primitive.name in counts:
-                counts[eqn.primitive.name] += 1
-            for sub in _subjaxprs(eqn):
-                walk(sub)
-
-    walk(jaxpr)
-    return counts
-
-
-def while_body_primitive_counts(fn, args, names: tuple[str, ...]) -> list[dict]:
-    """Primitive counts inside each ``while_loop`` body of ``fn``'s jaxpr
-    (one dict per loop, outermost-first)."""
-    jaxpr = jax.make_jaxpr(fn)(*args)
-    out: list[dict] = []
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "while":
-                body = eqn.params["body_jaxpr"]
-                out.append(
-                    count_primitives(
-                        body.jaxpr if hasattr(body, "jaxpr") else body, names
-                    )
-                )
-            else:
-                for sub in _subjaxprs(eqn):
-                    walk(sub)
-
-    walk(jaxpr.jaxpr)
-    return out
-
-
-def loop_primitive_counts(
-    fn, args, names: tuple[str, ...] = COLLECTIVE_PRIMS
-) -> dict[str, int]:
-    """Per-iteration primitive counts: the sum over all while bodies.
-
-    The solvers hold exactly one hot ``while_loop``; summing keeps the
-    answer right if an engine ever splits its iteration across two.
-    """
-    merged = {name: 0 for name in names}
-    for body in while_body_primitive_counts(fn, args, names):
-        for name, n in body.items():
-            merged[name] += n
-    return merged
-
-
-def loop_collectives(fn, args) -> tuple[int, int]:
-    """(psum, ppermute) per iteration, with the ``psum_invariant``
-    spelling folded into psum (one collective on the wire). The compact
-    form every cadence pin compares — the ABFT checks-on-vs-off
-    equality in ``tests/test_elastic.py`` and the ``abft`` bench key
-    both assert on exactly this pair."""
-    counts = loop_primitive_counts(fn, args)
-    return (
-        counts.get("psum", 0) + counts.get("psum_invariant", 0),
-        counts.get("ppermute", 0),
-    )
 
 
 # -- XLA cost analysis -------------------------------------------------------

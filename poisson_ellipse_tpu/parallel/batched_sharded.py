@@ -268,7 +268,6 @@ def build_sharded_chunk_advance(
 
     # no donation: the carry is re-read at every chunk boundary for the
     # scheduler's retire/refill host work
-    # tpulint: disable=TPU004
     return jax.jit(mapped), proto
 
 

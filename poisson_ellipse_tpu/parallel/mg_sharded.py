@@ -409,19 +409,19 @@ def build_mg_sharded_stepper(
     level_specs = (spec,) * n_level_args
     # no donation on any half: operands are re-fed every chunk and the
     # carry doubles as the guard's rollback point
-    init_mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
+    init_mapped = jax.jit(shard_map(
         init_shard,
         mesh=mesh,
         in_specs=(spec, spec, spec) + level_specs,
         out_specs=state_specs,
     ))
-    advance_mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
+    advance_mapped = jax.jit(shard_map(
         advance_shard,
         mesh=mesh,
         in_specs=(spec, spec, state_specs, scalar) + level_specs,
         out_specs=state_specs,
     ))
-    recover_mapped = jax.jit(shard_map(  # tpulint: disable=TPU004
+    recover_mapped = jax.jit(shard_map(
         recover_shard,
         mesh=mesh,
         in_specs=(spec, spec, spec, state_specs) + level_specs,

@@ -320,7 +320,6 @@ def build_pipelined_sharded_solver(
 
     # no donation: the build-once-call-many contract re-feeds these
     # operands on every dispatch (bench --repeat, chained solves)
-    # tpulint: disable=TPU004
     return jax.jit(solver), args
 
 

@@ -444,7 +444,7 @@ class _ClassicalAdapter:
                     jnp.asarray(False), jnp.asarray(False),
                 )
 
-            return adapter, jax.jit(to_pipelined)  # tpulint: disable=TPU006
+            return adapter, jax.jit(to_pipelined)
         if self.precond_kind == "mg":
             # the carry layout is shared, so the iterate/direction hand
             # straight over; recover() rebuilds z/zr under the new M.

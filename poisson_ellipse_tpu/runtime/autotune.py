@@ -488,7 +488,7 @@ def _measure_once(problem: Problem, engine: str, dtype, geometry=None,
     fence(solver(*args))  # compile + warm-up, untimed
     t0 = time.perf_counter()
     # the sync IS the measurement — the bracket closes on device work
-    fence(solver(*args))  # tpulint: disable=TPU008
+    fence(solver(*args))
     return time.perf_counter() - t0
 
 

@@ -108,41 +108,98 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 #   tunables  — the engine's autotunable knobs with their static
 #               defaults (what runtime.autotune turns and what tpulint
 #               TPU019 fences from being hardcoded at call sites)
+#   contracts — the engine's jaxpr-level structural guarantees, checked
+#               by the declarative contract matrix (analysis.contracts;
+#               `python -m poisson_ellipse_tpu.analysis`). Keys are
+#               deviations from analysis.contracts.CONTRACT_DEFAULTS:
+#                 sharded_psum     — psums per sharded while body
+#                                    (None = the engine has no sharded
+#                                    form; the matrix skips that cell)
+#                 sharded_halo     — halo exchanges per sharded body
+#                                    (each is 4 ppermutes), "precond"
+#                                    = stencil + the V-cycle/Chebyshev
+#                                    budget (mg_sharded.
+#                                    halos_per_precond), None = the
+#                                    count is deliberately unpinned
+#                                    (pipelined's replacement branch)
+#                 batched_psum/_halo — the lane-sharded cadence
+#                 abft             — the ABFT stepper must add ZERO
+#                                    collectives (on/off identity)
+#                 guard            — the guard adapter family whose
+#                                    chunk advance must trace the
+#                                    byte-identical unguarded jaxpr
+#                 storage_identity — storage_dtype=None must trace the
+#                                    byte-identical pre-storage jaxpr
+#                 storage_narrow   — a bf16-storage body must widen on
+#                                    load and narrow on store
+#                 history_resident — history=True stays device-resident
+#                                    (no callbacks), history=False adds
+#                                    no dynamic_update_slice
+#                 fcycle_budget    — whole-trace ppermute budget
+#                                    (halos_per_fcycle) applies
+#               A row WITHOUT this key is itself a finding: registering
+#               an engine means declaring its structural contract.
 ENGINE_CAPS = {
     "resident": dict(family="megakernel", storage=False, history=False,
-                     capacity=0, precond_kind=None, tunables={}),
+                     capacity=0, precond_kind=None, tunables={},
+                     contracts={}),
     "streamed": dict(family="megakernel", storage=True, history=False,
-                     capacity=1, precond_kind=None, tunables={}),
+                     capacity=1, precond_kind=None, tunables={},
+                     contracts={}),
     "xl": dict(family="megakernel", storage=True, history=False,
-               capacity=2, precond_kind=None, tunables={}),
+               capacity=2, precond_kind=None, tunables={},
+               contracts={}),
     "xla": dict(family="loop", storage=True, history=True,
-                capacity=3, precond_kind=None, tunables={}),
+                capacity=3, precond_kind=None, tunables={},
+                contracts=dict(sharded_psum=2, sharded_halo=1, abft=True,
+                               guard="classical", storage_identity=True,
+                               storage_narrow=True, history_resident=True)),
     "fused": dict(family="loop", storage=False, history=True,
-                  capacity=None, precond_kind=None, tunables={}),
+                  capacity=None, precond_kind=None, tunables={},
+                  contracts=dict(sharded_psum=2, sharded_halo=1,
+                                 history_resident=True)),
     "pallas": dict(family="loop", storage=True, history=True,
-                   capacity=None, precond_kind=None, tunables={}),
+                   capacity=None, precond_kind=None, tunables={},
+                   contracts=dict(sharded_psum=2, sharded_halo=1,
+                                  history_resident=True)),
     "pipelined": dict(family="loop", storage=True, history=True,
-                      capacity=None, precond_kind=None, tunables={}),
+                      capacity=None, precond_kind=None, tunables={},
+                      contracts=dict(sharded_psum=1, abft=True,
+                                     guard="pipelined",
+                                     storage_identity=True,
+                                     storage_narrow=True,
+                                     history_resident=True)),
     "pipelined-pallas": dict(family="loop", storage=True, history=True,
-                             capacity=None, precond_kind=None, tunables={}),
+                             capacity=None, precond_kind=None, tunables={},
+                             contracts=dict(history_resident=True)),
     "batched": dict(family="batched", storage=True, history=False,
                     capacity=None, precond_kind=None,
-                    tunables={"chunk": 16}),
+                    tunables={"chunk": 16},
+                    contracts=dict(batched_psum=1, batched_halo=0)),
     "batched-pipelined": dict(family="batched", storage=False,
                               history=False, capacity=None,
-                              precond_kind=None, tunables={"chunk": 16}),
+                              precond_kind=None, tunables={"chunk": 16},
+                              contracts=dict(batched_psum=1,
+                                             batched_halo=0)),
     "mg-pcg": dict(family="precond", storage=False, history=True,
                    capacity=None, precond_kind="mg",
-                   tunables={"levels": None, "nu": 2, "coarse_degree": 24}),
+                   tunables={"levels": None, "nu": 2, "coarse_degree": 24},
+                   contracts=dict(sharded_psum=2, sharded_halo="precond",
+                                  abft=True)),
     "cheb-pcg": dict(family="precond", storage=False, history=True,
                      capacity=None, precond_kind="cheb",
-                     tunables={"cheb_degree": 12}),
+                     tunables={"cheb_degree": 12},
+                     contracts=dict(sharded_psum=2, sharded_halo="precond",
+                                    abft=True)),
     "sstep": dict(family="sstep", storage=True, history=False,
                   capacity=None, precond_kind=None,
-                  tunables={"sstep_s": 4}),
+                  tunables={"sstep_s": 4},
+                  contracts=dict(sharded_psum=1, sharded_halo=1, abft=True,
+                                 storage_narrow=True)),
     "sstep-pallas": dict(family="sstep", storage=True, history=False,
                          capacity=None, precond_kind=None,
-                         tunables={"sstep_s": 4}),
+                         tunables={"sstep_s": 4},
+                         contracts={}),
     # full multigrid as the SOLVER (mg.fmg): one O(N) F-cycle + the
     # verified mg-pcg handoff. precond_kind "mg" keys its traffic model
     # and guard fallback ladder on the V-cycle's; family "fmg" keeps it
@@ -150,8 +207,19 @@ ENGINE_CAPS = {
     "fmg": dict(family="fmg", storage=False, history=True,
                 capacity=None, precond_kind="mg",
                 tunables={"levels": None, "nu": 2, "coarse_degree": 24,
-                          "n_vcycles": 2}),
+                          "n_vcycles": 2},
+                contracts=dict(sharded_psum=2, sharded_halo="precond",
+                               fcycle_budget=True)),
 }
+
+# engines with a mesh-sharded form (a declared sharded collective
+# cadence): the tuple obs.static_cost and the harness gate sharded-mode
+# requests against — derived from the contract metadata, not
+# hand-maintained alongside it.
+SHARDED_ENGINES = tuple(
+    e for e, c in ENGINE_CAPS.items()
+    if c["contracts"].get("sharded_psum") is not None
+)
 
 ENGINES = ("auto",) + tuple(ENGINE_CAPS)
 
@@ -348,7 +416,7 @@ def build_solver(
                                 theta=theta)
         # no donation: the build-once-call-many contract re-feeds these
         # operands on every dispatch (the timing protocols re-dispatch)
-        solver = jax.jit(run)  # tpulint: disable=TPU004
+        solver = jax.jit(run)
         return solver, args, engine
     if engine == "auto":
         # the autotuner's persisted, regression-gated winner for this

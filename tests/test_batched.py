@@ -272,25 +272,17 @@ def test_pipelined_lane_fault_also_quarantined(problem):
 
 @pytest.mark.parametrize("pipelined", [True, False])
 def test_lane_sharded_exactly_one_psum_per_while_body(pipelined):
-    from poisson_ellipse_tpu.obs.static_cost import (
-        COLLECTIVE_PRIMS,
-        loop_primitive_counts,
-    )
-    from poisson_ellipse_tpu.parallel.batched_sharded import (
-        build_batched_sharded_solver,
-    )
-    from poisson_ellipse_tpu.parallel.mesh import make_mesh
+    from poisson_ellipse_tpu.analysis.contracts import assert_contract
 
-    mesh = make_mesh(jax.devices()[:2])
-    solver, args = build_batched_sharded_solver(
-        Problem(M=40, N=40), mesh, lanes=4, dtype=jnp.float32,
-        pipelined=pipelined,
-    )
-    counts = loop_primitive_counts(solver, args, COLLECTIVE_PRIMS)
     # exactly ONE collective — the convergence word; the dot bundles are
-    # lane-local (whole lanes per device), so the count is flat in B
-    assert counts["psum"] + counts["psum_invariant"] == 1
-    assert counts["ppermute"] == 0
+    # lane-local (whole lanes per device), so the count is flat in B:
+    # the declared batched-cadence contract, from the ENGINE_CAPS row
+    engine = "batched-pipelined" if pipelined else "batched"
+    r = assert_contract(
+        "batched-cadence", engine, problem=Problem(M=40, N=40),
+        mesh_shape=(1, 2), lanes=4,
+    )
+    assert r.expected == {"psum": 1, "ppermute": 0}
 
 
 def test_lane_sharded_solves_match_single(problem, single):

@@ -583,3 +583,76 @@ def test_autotune_only_in_one_round_is_noted_not_failed():
     regs, notes = bc.compare(old, new, TOL)
     assert not regs
     assert any("autotune" in n for n in notes)
+
+
+# ------------------------------------------------ engine-contract stamping
+
+
+def test_contracts_violated_new_round_is_a_regression():
+    old = make_round(contracts={"hash": "a" * 64, "clean": True})
+    new = make_round(contracts={"hash": "a" * 64, "clean": False})
+    assert ("contracts_clean", "contracts") in regressions_between(old, new)
+
+
+def test_contracts_hash_change_is_noted_not_failed():
+    old = make_round(contracts={"hash": "a" * 64, "clean": True})
+    new = make_round(contracts={"hash": "b" * 64, "clean": True})
+    regs, notes = bc.compare(old, new, TOL)
+    assert not regs
+    assert any("contracts" in n and "hash changed" in n for n in notes)
+
+
+def test_contracts_identical_state_is_silent():
+    old = make_round(contracts={"hash": "a" * 64, "clean": True})
+    new = make_round(contracts={"hash": "a" * 64, "clean": True})
+    regs, notes = bc.compare(old, new, TOL)
+    assert not regs
+    assert not any("contracts" in n for n in notes)
+
+
+def test_contracts_only_in_one_round_is_noted_not_failed():
+    old = make_round()
+    new = make_round(contracts={"hash": "a" * 64, "clean": True})
+    regs, notes = bc.compare(old, new, TOL)
+    assert not regs
+    assert any("contracts: only in one round" in n for n in notes)
+
+
+def test_stamp_embeds_contract_state(tmp_path, monkeypatch, capsys):
+    from poisson_ellipse_tpu.analysis import matrix
+
+    monkeypatch.setattr(
+        matrix, "run_matrix", lambda *a, **k: {"clean": True, "cells": []}
+    )
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps({"parsed": make_round()}))
+    assert bc.stamp(str(p)) == 0
+    out = capsys.readouterr().out
+    assert "contracts clean" in out
+    stamped = json.loads(p.read_text())["parsed"]["contracts"]
+    assert stamped["clean"] is True and len(stamped["hash"]) == 64
+    # the stamped round now compares against an unstamped one as a note
+    regs, notes = bc.compare(
+        make_round(), json.loads(p.read_text())["parsed"], TOL
+    )
+    assert not regs
+    assert any("contracts: only in one round" in n for n in notes)
+
+
+def test_stamp_not_clean_exits_1_but_still_writes(tmp_path, monkeypatch):
+    from poisson_ellipse_tpu.analysis import matrix
+
+    monkeypatch.setattr(
+        matrix, "run_matrix", lambda *a, **k: {"clean": False, "cells": []}
+    )
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(make_round()))  # raw bench line, no "parsed"
+    assert bc.stamp(str(p)) == 1
+    assert json.loads(p.read_text())["contracts"]["clean"] is False
+
+
+def test_stamp_unreadable_input_exits_2(tmp_path, capsys):
+    bad = tmp_path / "BENCH_r03.json"
+    bad.write_text("{not json")
+    assert bc.main(["--stamp", str(bad)]) == 2
+    assert bc.main(["--stamp"]) == 2  # missing operand is usage, not crash

@@ -1,0 +1,397 @@
+"""The engine-contract checker gate (analysis/): matrix clean + every
+contract kind fires.
+
+Three layers of pinning:
+
+1. The full ENGINE_CAPS-derived matrix runs clean, UNSUPPRESSED — a
+   ``[tool.engine_contracts]`` suppression can quiet the CLI but never
+   hide a contract regression from tier-1.
+2. A golden snapshot of the reduced report (engine, axis, kind, status,
+   expected) — adding an engine, declaring a new contract, or changing a
+   derived budget must show up as a reviewed diff of
+   ``tests/golden_contract_matrix.json``. Deliberate drift: regenerate
+   with the snippet in that file's sibling test below.
+3. Injected-violation fixtures: every contract kind must FIRE when fed
+   a wrong expectation or a tampered trace — a checker that cannot fail
+   is not a check.
+
+The snapshot deliberately excludes ``actual`` values that the contracts
+leave unpinned (e.g. the pipelined body's replacement-branch ppermutes),
+so it ratchets exactly what the contracts pin and nothing more.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from poisson_ellipse_tpu.analysis import contracts, jaxpr_scan, matrix
+from poisson_ellipse_tpu.analysis.contracts import (
+    CONTRACT_KINDS,
+    assert_contract,
+    check_contract,
+    check_engine_metadata,
+    engine_contract_spec,
+)
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.solver.engine import ENGINE_CAPS
+
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "golden_contract_matrix.json"
+)
+
+
+@pytest.fixture(scope="module")
+def full_report():
+    # suppressions={} — the tier-1 gate always runs unsuppressed
+    return matrix.run_matrix(suppressions={})
+
+
+# -- 1. the full matrix, clean -----------------------------------------------
+
+
+def test_full_contract_matrix_is_clean(full_report):
+    assert full_report["clean"], "\n".join(full_report["violations"])
+    s = full_report["summary"]
+    assert s["fail"] == 0 and s["error"] == 0 and s["suppressed"] == 0
+    assert matrix.exit_code(full_report) == 0
+
+
+def test_matrix_covers_every_engine_and_kind(full_report):
+    """Coverage, not just cleanliness: every registered engine holds at
+    least one cell, and every contract kind runs somewhere — an engine
+    or kind silently dropping out of the sweep is itself a failure."""
+    cells = full_report["cells"]
+    swept_engines = {r["engine"] for r in cells} - {"*"}
+    assert swept_engines == set(ENGINE_CAPS)
+    swept_kinds = {r["kind"] for r in cells}
+    assert swept_kinds == set(CONTRACT_KINDS)
+
+
+def test_contract_report_matches_golden_snapshot(full_report):
+    """Regenerate (after a REVIEWED contract change) with::
+
+        python -m poisson_ellipse_tpu.analysis --format json \\
+            --no-suppressions -o /tmp/report.json
+        python - <<'PY'
+        import json
+        rep = json.load(open("/tmp/report.json"))
+        reduced = sorted(({k: r[k] for k in
+            ("engine", "axis", "kind", "status", "expected")}
+            for r in rep["cells"]),
+            key=lambda r: (r["engine"], r["axis"], r["kind"]))
+        with open("tests/golden_contract_matrix.json", "w") as f:
+            json.dump(reduced, f, indent=2, sort_keys=True); f.write("\\n")
+        PY
+    """
+    reduced = sorted(
+        (
+            {
+                k: r[k]
+                for k in ("engine", "axis", "kind", "status", "expected")
+            }
+            for r in full_report["cells"]
+        ),
+        key=lambda r: (r["engine"], r["axis"], r["kind"]),
+    )
+    with open(GOLDEN, encoding="utf-8") as f:
+        golden = json.load(f)
+    assert reduced == golden, (
+        "the contract matrix drifted from tests/golden_contract_matrix"
+        ".json — if the change is deliberate, regenerate per the "
+        "docstring"
+    )
+
+
+def test_report_hash_is_deterministic(full_report):
+    h = matrix.report_hash(full_report)
+    assert h == matrix.report_hash(json.loads(json.dumps(full_report)))
+    mutated = json.loads(json.dumps(full_report))
+    mutated["cells"][0]["status"] = "fail"
+    assert matrix.report_hash(mutated) != h
+
+
+# -- 2. classification, suppression, ratchet ---------------------------------
+
+
+def _force_fail(monkeypatch, kind="guard-overhead"):
+    def fake(k, engine, **kw):
+        return contracts.ContractResult(
+            kind=k, engine=engine, status="fail",
+            expected={"identical": True}, actual={"identical": False},
+            violations=(contracts.Violation(k, engine, "injected"),),
+        )
+
+    monkeypatch.setattr(contracts, "check_contract", fake)
+    return "xla:guarded:" + kind
+
+
+def test_matrix_exit_1_on_violation_and_0_when_suppressed(monkeypatch):
+    cid = _force_fail(monkeypatch)
+    rep = matrix.run_matrix(("xla",), ("guarded",), suppressions={})
+    assert not rep["clean"] and matrix.exit_code(rep) == 1
+    assert any(m.endswith("injected") for m in rep["violations"])
+
+    rep2 = matrix.run_matrix(
+        ("xla",), ("guarded",), suppressions={cid: "known drift, #123"}
+    )
+    row = [r for r in rep2["cells"] if r["kind"] == "guard-overhead"][0]
+    assert row["status"] == "suppressed"
+    assert row["suppressed_reason"] == "known drift, #123"
+    assert rep2["clean"] and matrix.exit_code(rep2) == 0
+    assert rep2["unused_suppressions"] == []
+    # the render names the suppressed cell with its reason
+    assert "known drift, #123" in matrix.render_report(rep2)
+
+
+def test_matrix_reports_unused_suppressions(monkeypatch):
+    _force_fail(monkeypatch)
+    rep = matrix.run_matrix(
+        ("xla",), ("guarded",), suppressions={"stale:cell:kind": "gone"}
+    )
+    assert rep["unused_suppressions"] == ["stale:cell:kind"]
+    assert "unused suppression: stale:cell:kind" in matrix.render_report(rep)
+
+
+def test_matrix_classifies_checker_crash_as_exit_2(monkeypatch):
+    def boom(kind, engine, **kw):
+        raise RuntimeError("tracer exploded")
+
+    monkeypatch.setattr(contracts, "check_contract", boom)
+    rep = matrix.run_matrix(("xla",), ("guarded",), suppressions={})
+    rows = [r for r in rep["cells"] if r["axis"] != "registry"]
+    assert rows and all(r["status"] == "error" for r in rows)
+    assert "RuntimeError: tracer exploded" in rows[0]["messages"][0]
+    assert matrix.exit_code(rep) == 2  # error trumps fail
+
+
+def test_load_suppressions_parses_reasons_and_rejects_garbage(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.engine_contracts]\n"
+        'suppress = ["xla:sharded:collective-cadence: tracked in #7",'
+        ' "fmg:sharded:fcycle-budget"]\n',
+        encoding="utf-8",
+    )
+    sup = matrix.load_suppressions(str(tmp_path))
+    assert sup == {
+        "xla:sharded:collective-cadence": "tracked in #7",
+        "fmg:sharded:fcycle-budget": "(no reason given)",
+    }
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.engine_contracts]\nsuppress = ["not a cell id"]\n',
+        encoding="utf-8",
+    )
+    with pytest.raises(SystemExit, match="not a cell id"):
+        matrix.load_suppressions(str(tmp_path))
+    assert matrix.load_suppressions(str(tmp_path / "missing")) == {}
+
+
+def test_repo_pyproject_suppressions_load_and_are_all_used(full_report):
+    """The checked-in suppress list parses, and (ratchet) every entry
+    still matches a failing cell — with a clean matrix that means the
+    list must be empty."""
+    sup = matrix.load_suppressions()
+    failing = {
+        matrix.cell_id(r["engine"], r["axis"], r["kind"])
+        for r in full_report["cells"]
+        if r["status"] == "fail"
+    }
+    stale = set(sup) - failing
+    assert not stale, f"stale [tool.engine_contracts] entries: {stale}"
+
+
+# -- 3. every contract kind fires on an injected violation -------------------
+
+
+def test_engine_metadata_fires_on_undeclared_engine():
+    caps = {"good": {"contracts": {}}, "bad": {"family": "loop"}}
+    v = check_engine_metadata(caps)
+    assert [x.engine for x in v] == ["bad"]
+    assert "without contract metadata" in v[0].message
+    assert v[0].render().startswith("bad: engine-metadata:")
+
+
+def test_engine_metadata_fires_on_unknown_key():
+    caps = {"typo": {"contracts": {"sharded_psums": 2}}}
+    v = check_engine_metadata(caps)
+    assert len(v) == 1 and "unknown contract key" in v[0].message
+    with pytest.raises(ValueError, match="sharded_psums"):
+        engine_contract_spec("typo", caps)
+
+
+def test_single_collective_free_fires_on_collective_trace(monkeypatch):
+    # feed the sharded build (which legitimately holds collectives)
+    # through the single-chip check: the contract must fire
+    monkeypatch.setattr(
+        contracts,
+        "_build_single",
+        lambda problem, engine, dtype, **kw: contracts._build_sharded(
+            problem, "xla", dtype, (1, 2)
+        ),
+    )
+    r = check_contract("single-collective-free", "xla")
+    assert r.status == "fail"
+    assert "holds collectives" in r.violations[0].message
+
+
+def test_collective_cadence_fires_on_wrong_expectation():
+    r = check_contract("collective-cadence", "xla", expect=(99, 0))
+    assert r.status == "fail" and len(r.violations) == 2
+    assert r.actual == {"psum": 2, "ppermute": 4}
+    with pytest.raises(AssertionError, match="99"):
+        assert_contract("collective-cadence", "xla", expect=(99, 0))
+
+
+def test_batched_cadence_fires_on_wrong_expectation():
+    r = check_contract("batched-cadence", "batched", expect=(99, 4))
+    assert r.status == "fail" and len(r.violations) == 2
+    assert r.actual == {"psum": 1, "ppermute": 0}
+
+
+def test_abft_identity_fires_on_wrong_declared_psum():
+    spec = dict(engine_contract_spec("xla"))
+    spec["sharded_psum"] = 99
+    r = contracts._check_abft_identity(
+        "xla", spec, Problem(M=16, N=16), jnp.float32, mesh_shape=(1, 2)
+    )
+    assert r.status == "fail"
+    assert "contract says 99" in r.violations[0].message
+
+
+def _tamper_every_second_trace(monkeypatch, extra="\n# tampered"):
+    real = jaxpr_scan.trace_text
+    calls = {"n": 0}
+
+    def tampered(fn, args):
+        calls["n"] += 1
+        text = real(fn, args)
+        return text + extra if calls["n"] % 2 == 0 else text
+
+    monkeypatch.setattr(jaxpr_scan, "trace_text", tampered)
+
+
+def test_guard_overhead_fires_on_divergent_trace(monkeypatch):
+    _tamper_every_second_trace(monkeypatch)
+    r = check_contract("guard-overhead", "xla")
+    assert r.status == "fail"
+    assert "zero-overhead-when-healthy" in r.violations[0].message
+
+
+def test_storage_identity_fires_on_divergent_trace(monkeypatch):
+    _tamper_every_second_trace(monkeypatch)
+    r = check_contract("storage-identity", "xla")
+    assert r.status == "fail"
+    assert "free-when-off" in r.violations[0].message
+
+
+def test_storage_narrow_fires_when_no_conversions_found(monkeypatch):
+    monkeypatch.setattr(
+        jaxpr_scan, "convert_dtype_pairs", lambda body: []
+    )
+    r = check_contract("storage-narrow", "xla")
+    assert r.status == "fail" and len(r.violations) == 2
+    assert r.actual == {"widens": False, "narrows": False}
+
+
+def test_history_free_fires_on_divergent_trace(monkeypatch):
+    _tamper_every_second_trace(monkeypatch)
+    r = check_contract("history-free", "xla")
+    assert r.status == "fail"
+    assert "not free when off" in r.violations[0].message
+
+
+def test_history_resident_fires_on_host_bound_trace(monkeypatch):
+    monkeypatch.setattr(
+        jaxpr_scan,
+        "trace_text",
+        lambda fn, args: "while ... callback ... device_get",
+    )
+    r = check_contract("history-resident", "xla")
+    assert r.status == "fail"
+    msgs = " ".join(v.message for v in r.violations)
+    assert "dynamic_update_slice" in msgs and "device-resident" in msgs
+
+
+def test_fcycle_budget_fires_on_missing_exchanges(monkeypatch):
+    monkeypatch.setattr(
+        jaxpr_scan, "count_primitives", lambda jaxpr, names: {"ppermute": 0}
+    )
+    r = check_contract("fcycle-budget", "fmg")
+    assert r.status == "fail"
+    assert "hidden exchange" in r.violations[0].message
+    assert r.expected["ppermute_total"] > 0
+
+
+def test_check_contract_rejects_unknown_and_inapplicable():
+    with pytest.raises(ValueError, match="unknown contract kind"):
+        check_contract("no-such-contract", "xla")
+    # fcycle-budget is fmg-only: running it elsewhere is a usage error,
+    # not a silent pass
+    with pytest.raises(ValueError, match="does not apply"):
+        check_contract("fcycle-budget", "xla")
+
+
+# -- 4. SARIF + CLI surface --------------------------------------------------
+
+
+def test_report_to_sarif_carries_non_pass_cells():
+    report = {
+        "cells": [
+            {"engine": "xla", "axis": "sharded",
+             "kind": "collective-cadence", "status": "pass",
+             "messages": []},
+            {"engine": "xla", "axis": "guarded", "kind": "guard-overhead",
+             "status": "fail", "messages": ["broke"]},
+            {"engine": "fmg", "axis": "sharded", "kind": "fcycle-budget",
+             "status": "suppressed", "messages": [],
+             "suppressed_reason": "tracked"},
+        ],
+    }
+    doc = matrix.report_to_sarif(report)
+    results = doc["runs"][0]["results"]
+    assert [r["level"] for r in results] == ["error", "note"]
+    assert results[0]["ruleId"] == "guard-overhead"
+    assert "xla:guarded:guard-overhead: broke" in (
+        results[0]["message"]["text"]
+    )
+    rule_ids = {
+        r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]
+    }
+    assert rule_ids == set(CONTRACT_KINDS)
+
+
+def test_cli_list_contracts(capsys):
+    from poisson_ellipse_tpu.analysis.__main__ import main
+
+    assert main(["--list-contracts"]) == 0
+    out = capsys.readouterr().out
+    for kind in CONTRACT_KINDS:
+        assert kind in out
+
+
+def test_cli_restricted_run_json_sarif_and_hash(tmp_path, capsys):
+    from poisson_ellipse_tpu.analysis.__main__ import main
+
+    out_json = tmp_path / "report.json"
+    rc = main(
+        ["--engine", "xla", "--axis", "guarded", "--format", "json",
+         "-o", str(out_json), "--hash"]
+    )
+    assert rc == 0
+    rep = json.loads(out_json.read_text(encoding="utf-8"))
+    assert rep["clean"] and rep["summary"]["fail"] == 0
+    assert "report-hash: " in capsys.readouterr().out
+
+    out_sarif = tmp_path / "report.sarif"
+    rc = main(
+        ["--engine", "xla", "--axis", "guarded", "--format", "sarif",
+         "-o", str(out_sarif)]
+    )
+    assert rc == 0
+    doc = json.loads(out_sarif.read_text(encoding="utf-8"))
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["tool"]["driver"]["name"] == "engine-contracts"
+    assert doc["runs"][0]["results"] == []  # clean run, no findings

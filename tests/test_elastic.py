@@ -24,19 +24,15 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from poisson_ellipse_tpu.analysis.contracts import assert_contract
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.obs import trace as obs_trace
-from poisson_ellipse_tpu.obs.static_cost import loop_collectives
 from poisson_ellipse_tpu.parallel import elastic
 from poisson_ellipse_tpu.parallel.mesh import AXIS_X, AXIS_Y
-from poisson_ellipse_tpu.parallel.mg_sharded import build_mg_sharded_stepper
 from poisson_ellipse_tpu.parallel.pcg_sharded import (
     build_sharded_stepper,
     sharded_result_of,
     solve_sharded,
-)
-from poisson_ellipse_tpu.parallel.pipelined_sharded import (
-    build_pipelined_sharded_stepper,
 )
 from poisson_ellipse_tpu.resilience import (
     DeviceLossError,
@@ -75,44 +71,31 @@ def clean(mesh22):
 # -- 1. the zero-cost / healthy-path contract --------------------------------
 
 
-def _collectives(init_fn, advance_fn):
-    # abstract state via eval_shape: the pin reads the JAXPR only — no
-    # reason to compile (or run) the init just to shape the trace
-    state = jax.eval_shape(init_fn)
-    return loop_collectives(advance_fn, (state, 10))
+def test_abft_adds_zero_collectives_classical():
+    # the declared contract (abft-identity derives its expectations from
+    # ENGINE_CAPS); the exact classical cadence is re-pinned on `actual`
+    r = assert_contract(
+        "abft-identity", "xla", problem=PROBLEM, dtype=jnp.float64,
+        mesh_shape=(2, 2),
+    )
+    assert r.actual == {"off": (2, 4), "on": (2, 4)}, r.actual
 
 
-def test_abft_adds_zero_collectives_classical(mesh22):
-    per_iter = {}
-    for flag in (False, True):
-        init_fn, advance_fn = build_sharded_stepper(
-            PROBLEM, mesh22, jnp.float64, abft=flag
-        )
-        per_iter[flag] = _collectives(init_fn, advance_fn)
-    assert per_iter[True] == per_iter[False] == (2, 4), per_iter
-
-
-def test_abft_adds_zero_collectives_pipelined(mesh22):
-    per_iter = {}
-    for flag in (False, True):
-        init_fn, advance_fn = build_pipelined_sharded_stepper(
-            PROBLEM, mesh22, jnp.float64, abft=flag
-        )
-        per_iter[flag] = _collectives(init_fn, advance_fn)
+def test_abft_adds_zero_collectives_pipelined():
     # the pipelined iteration's ONE stacked psum (+ the replacement
     # branch's halo traffic counted in the body) must not grow
-    assert per_iter[True] == per_iter[False], per_iter
-    assert per_iter[True][0] == 1
+    r = assert_contract(
+        "abft-identity", "pipelined", problem=PROBLEM, dtype=jnp.float64,
+        mesh_shape=(2, 2),
+    )
+    assert r.actual["on"][0] == 1, r.actual
 
 
-def test_abft_adds_zero_collectives_mg(mesh22):
-    per_iter = {}
-    for flag in (False, True):
-        init_fn, advance_fn, _rec = build_mg_sharded_stepper(
-            PROBLEM, mesh22, jnp.float64, kind="mg", abft=flag
-        )
-        per_iter[flag] = _collectives(init_fn, advance_fn)
-    assert per_iter[True] == per_iter[False], per_iter
+def test_abft_adds_zero_collectives_mg():
+    assert_contract(
+        "abft-identity", "mg-pcg", problem=PROBLEM, dtype=jnp.float64,
+        mesh_shape=(2, 2),
+    )
 
 
 def test_abft_healthy_path_is_silent_and_at_parity(mesh22, clean):

@@ -212,29 +212,28 @@ def test_fmg_sharded_collective_budget_jaxpr_pinned():
     the whole computation's ppermute count covers exactly ONE F-cycle
     (``halos_per_fcycle``) + one handoff-loop body + the per-dispatch
     operand extension — no hidden exchanges."""
+    from poisson_ellipse_tpu.analysis.contracts import assert_contract
     from poisson_ellipse_tpu.mg.fmg import DEFAULT_FMG_VCYCLES
-    from poisson_ellipse_tpu.obs import static_cost
     from poisson_ellipse_tpu.parallel.mg_sharded import (
-        build_fmg_sharded_solver,
         halos_per_fcycle,
         halos_per_precond,
     )
 
     problem = Problem(M=16, N=16)
-    mesh = mesh_of(2)
-    solver, args = build_fmg_sharded_solver(problem, mesh)
-    counts = static_cost.loop_primitive_counts(solver, args)
-    psum = counts.get("psum", 0) + counts.get("psum_invariant", 0)
-    assert psum == 2, counts  # the classical scalar cadence, untouched
     levels = coarsen.num_levels(16, 16)
     # per handoff iteration: one fine stencil + the V-cycle's halos
-    assert counts.get("ppermute", 0) == 4 * (
-        1 + halos_per_precond(levels)
-    ), counts
+    r = assert_contract(
+        "collective-cadence", "fmg", problem=problem, mesh_shape=(1, 2)
+    )
+    assert r.expected == {
+        "psum": 2,  # the classical scalar cadence, untouched
+        "ppermute": 4 * (1 + halos_per_precond(levels)),
+    }, "contract derivation drifted from the hand budget"
     # whole-computation budget: levels' coefficient extensions (once per
     # dispatch), ONE F-cycle, init's precond+stencil, the loop body
-    jaxpr = jax.make_jaxpr(solver)(*args)
-    total = static_cost.count_primitives(jaxpr.jaxpr, ("ppermute",))
+    rb = assert_contract(
+        "fcycle-budget", "fmg", problem=problem, mesh_shape=(1, 2)
+    )
     fcycle_halos = halos_per_fcycle(levels,
                                     n_vcycles=DEFAULT_FMG_VCYCLES)
     init_halos = 1 + halos_per_precond(levels)  # r0 stencil + z0 precond
@@ -242,9 +241,9 @@ def test_fmg_sharded_collective_budget_jaxpr_pinned():
     # coefficient extension: each level's (a, b) PAIR is halo-extended
     # once per dispatch — two exchanges per level
     extend = 2 * levels
-    assert total["ppermute"] == 4 * (
+    assert rb.expected["ppermute_total"] == 4 * (
         extend + fcycle_halos + init_halos + loop_halos
-    ), (total, fcycle_halos)
+    ), (rb.expected, fcycle_halos)
 
 
 @pytest.mark.slow

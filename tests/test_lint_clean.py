@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 
-from poisson_ellipse_tpu.lint import lint_paths, load_config
+from poisson_ellipse_tpu.lint import audit_paths, lint_paths, load_config
 from poisson_ellipse_tpu.lint.report import render_report
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -26,6 +26,19 @@ def test_package_lints_clean():
         "tpulint findings (fix, or annotate with "
         "`# tpulint: disable=CODE` plus a justification):\n"
         + render_report(findings, statistics=True)
+    )
+
+
+def test_package_suppressions_all_earn_their_keep():
+    # the annotation ratchet: every `# tpulint: disable` in the package
+    # must still suppress a live finding — stale waivers get deleted
+    config = load_config(REPO_ROOT)
+    paths = [os.path.join(REPO_ROOT, p) for p in config.paths]
+    findings, errors = audit_paths(paths, config)
+    assert not errors, "\n".join(e.render() for e in errors)
+    assert not findings, (
+        "stale tpulint suppressions (the hazard is gone — remove the "
+        "annotation):\n" + render_report(findings)
     )
 
 

@@ -365,35 +365,30 @@ def test_sharded_matches_single_chip(n_devices, kind):
 
 
 def test_sharded_collective_discipline_jaxpr_pinned():
-    """THE mesh regression pin: the convergence word stays EXACTLY one
-    stacked psum per iteration (total psum = 2 with the denom — the
-    classical cadence, preconditioner adds ZERO), and the V-cycle's
-    halo traffic is exactly the static ppermute budget
-    (``halos_per_precond``), read back from the jaxpr."""
-    from poisson_ellipse_tpu.obs.static_cost import loop_primitive_counts
-    from poisson_ellipse_tpu.parallel.mg_sharded import (
-        build_mg_sharded_solver,
-        halos_per_precond,
-    )
+    """THE mesh regression pin, as a declared contract: the convergence
+    word stays EXACTLY one stacked psum per iteration (total psum = 2
+    with the denom — the classical cadence, preconditioner adds ZERO),
+    and the V-cycle's halo traffic is exactly the static ppermute budget
+    (``halos_per_precond``), read back from the jaxpr by
+    ``analysis.contracts`` with the expectations derived from
+    ENGINE_CAPS — cross-checked here against the hand expression."""
+    from poisson_ellipse_tpu.analysis.contracts import assert_contract
+    from poisson_ellipse_tpu.parallel.mg_sharded import halos_per_precond
 
     problem = Problem(M=40, N=40)
-    mesh = mesh_of(2)
-    for kind in ("mg", "cheb"):
-        solver, args = build_mg_sharded_solver(
-            problem, mesh, jnp.float32, kind=kind
+    for kind, engine in (("mg", "mg-pcg"), ("cheb", "cheb-pcg")):
+        r = assert_contract(
+            "collective-cadence", engine, problem=problem,
+            dtype=jnp.float32, mesh_shape=(1, 2),
         )
-        counts = loop_primitive_counts(solver, args)
         cfg = default_config(problem, kind)
-        assert counts["psum"] + counts["psum_invariant"] == 2, (
-            f"{kind}: scalar-collective cadence broke: {counts}"
-        )
         halos = 1 + halos_per_precond(
             cfg.levels,
             cfg.nu,
             cfg.coarse_degree if kind == "mg" else cfg.cheb_degree,
         )
-        assert counts["ppermute"] == 4 * halos, (
-            f"{kind}: expected {4 * halos} ppermutes/iter, got {counts}"
+        assert r.expected == {"psum": 2, "ppermute": 4 * halos}, (
+            f"{kind}: contract derivation drifted from the hand budget"
         )
 
 

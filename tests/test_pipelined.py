@@ -248,29 +248,26 @@ def test_multichip_scaling_table_runs_pipelined():
 
 
 def test_pipelined_iteration_issues_exactly_one_psum():
-    """THE structural claim, asserted from the product metric
-    (``obs.static_cost.engine_report`` — the same accounting ``harness
-    inspect`` and the BENCH artifact carry, not a test-local jaxpr
+    """THE structural claim, asserted from the declared contract
+    (``analysis.contracts`` — the same checker the matrix CLI sweeps,
+    with expectations derived from ENGINE_CAPS, not a test-local jaxpr
     walk): the pipelined sharded loop body holds exactly 1 psum
-    collective per iteration; the classical sharded loop holds 2. (Halo
-    ppermutes are unaffected; the replacement branch adds none.)"""
-    from poisson_ellipse_tpu.obs.static_cost import engine_report
+    collective per iteration; the classical sharded loop holds 2 with
+    the 4-ppermute halo ring. (The pipelined body's ppermutes are
+    deliberately unpinned: the replacement branch's stacked exchanges
+    are static upper-bound accounting, not steady-state cost.)"""
+    from poisson_ellipse_tpu.analysis.contracts import assert_contract
 
     problem = Problem(M=40, N=40)
-    pipe = engine_report(
-        problem, "pipelined", mode="sharded", mesh_shape=(2, 2),
-        with_xla_cost=False,
+    pipe = assert_contract(
+        "collective-cadence", "pipelined", problem=problem,
+        mesh_shape=(2, 2),
     )
-    classical = engine_report(
-        problem, "xla", mode="sharded", mesh_shape=(2, 2),
-        with_xla_cost=False,
+    classical = assert_contract(
+        "collective-cadence", "xla", problem=problem, mesh_shape=(2, 2),
     )
-    assert pipe["psum_per_iter"] == 1
-    assert classical["psum_per_iter"] == 2
-    # the halo ring is 4 ppermutes either way (the classical count; the
-    # pipelined body adds the replacement branch's stacked exchanges,
-    # which are static upper-bound accounting, not steady-state cost)
-    assert classical["ppermute_per_iter"] == 4
+    assert pipe.expected["psum"] == 1
+    assert classical.expected == {"psum": 2, "ppermute": 4}
 
 
 # ------------------------------------------------------------ grid_dots

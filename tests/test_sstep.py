@@ -98,19 +98,13 @@ def test_build_solver_validates_the_new_axes():
 
 def test_storage_none_traces_the_identical_jaxpr():
     """The storage axis at None is byte-identical to the pre-storage
-    code: same jaxpr for classical AND pipelined."""
+    code: same jaxpr for classical AND pipelined — the declared
+    ``storage-identity`` contract (expectations from ENGINE_CAPS)."""
+    from poisson_ellipse_tpu.analysis.contracts import assert_contract
+
     problem = Problem(M=20, N=20)
-    a, b, rhs = _operands(problem)
-    base_cl = jax.make_jaxpr(lambda *o: pcg(problem, *o))(a, b, rhs)
-    none_cl = jax.make_jaxpr(
-        lambda *o: pcg(problem, *o, storage_dtype=None)
-    )(a, b, rhs)
-    assert str(base_cl) == str(none_cl)
-    base_pp = jax.make_jaxpr(lambda *o: pcg_pipelined(problem, *o))(a, b, rhs)
-    none_pp = jax.make_jaxpr(
-        lambda *o: pcg_pipelined(problem, *o, storage_dtype=None)
-    )(a, b, rhs)
-    assert str(base_pp) == str(none_pp)
+    assert_contract("storage-identity", "xla", problem=problem)
+    assert_contract("storage-identity", "pipelined", problem=problem)
 
 
 # -- s-step parity -----------------------------------------------------------
@@ -191,36 +185,31 @@ def test_sstep_sharded_matches_single_chip(mesh_shape):
 
 @pytest.mark.parametrize("s", SSTEP_CHOICES)
 def test_sstep_sharded_pins_one_psum_per_s_iterations(s):
-    """THE acceptance pin: the sharded s-step while body holds exactly
-    1 psum and 4 ppermutes — per body = per s iterations — abft on and
-    off byte-identical, vs the classical body's 2 psums."""
-    from poisson_ellipse_tpu.obs.static_cost import (
-        iters_per_loop_body,
-        loop_collectives,
-    )
-    from poisson_ellipse_tpu.parallel.pcg_sharded import (
-        build_sharded_solver,
-    )
-    from poisson_ellipse_tpu.parallel.sstep_sharded import (
-        build_sstep_sharded_solver,
-        build_sstep_sharded_stepper,
-    )
+    """THE acceptance pin, as declared contracts: the sharded s-step
+    while body holds exactly 1 psum and 4 ppermutes — per body = per s
+    iterations — abft on and off byte-identical, vs the classical
+    body's 2 psums. Expectations derive from ENGINE_CAPS; the exact
+    (1, 4) cadence is re-pinned on the results."""
+    from poisson_ellipse_tpu.analysis.contracts import assert_contract
+    from poisson_ellipse_tpu.obs.static_cost import iters_per_loop_body
 
     problem = Problem(M=40, N=40)
-    mesh = _mesh((1, 2))
-    solver, args = build_sstep_sharded_solver(
-        problem, mesh, jnp.float32, s=s
+    r = assert_contract(
+        "collective-cadence", "sstep", problem=problem,
+        mesh_shape=(1, 2), sstep_s=s,
     )
-    assert loop_collectives(solver, args) == (1, 4)
+    assert r.expected == {"psum": 1, "ppermute": 4}
     assert iters_per_loop_body("sstep", s) == s
-    for abft in (False, True):
-        init, adv = build_sstep_sharded_stepper(
-            problem, mesh, jnp.float32, s=s, abft=abft
-        )
-        state = init()
-        assert loop_collectives(lambda st: adv(st, 100), (state,)) == (1, 4)
-    classical, cargs = build_sharded_solver(problem, mesh, jnp.float32)
-    assert loop_collectives(classical, cargs)[0] == 2
+    # the stepper form, abft on == off, at the same (1, 4) cadence
+    ra = assert_contract(
+        "abft-identity", "sstep", problem=problem, mesh_shape=(1, 2),
+        sstep_s=s,
+    )
+    assert ra.actual == {"off": (1, 4), "on": (1, 4)}, ra.actual
+    rc = assert_contract(
+        "collective-cadence", "xla", problem=problem, mesh_shape=(1, 2)
+    )
+    assert rc.expected["psum"] == 2
 
 
 def test_engine_report_divides_body_counts_per_iteration():

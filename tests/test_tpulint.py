@@ -9,13 +9,22 @@ from CI, so the negatives are as load-bearing as the positives).
 
 from __future__ import annotations
 
+import json
 import subprocess
 import sys
 import textwrap
 
 import pytest
 
-from poisson_ellipse_tpu.lint import LintConfig, RULES, lint_source
+from poisson_ellipse_tpu.lint import (
+    AUDIT_CODE,
+    LintConfig,
+    RULES,
+    apply_baseline,
+    audit_suppressions,
+    finding_key,
+    lint_source,
+)
 from poisson_ellipse_tpu.lint.report import Finding, render_report
 
 
@@ -27,10 +36,10 @@ def codes_of(source: str, **cfg) -> list[str]:
 # -- registry shape ---------------------------------------------------------
 
 
-def test_registry_has_all_nineteen_rules():
+def test_registry_has_all_twenty_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
         "TPU010", "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
-        "TPU016", "TPU017", "TPU018", "TPU019",
+        "TPU016", "TPU017", "TPU018", "TPU019", "TPU020",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -2029,3 +2038,288 @@ def test_tpu019_suppression_comment():
         g = guarded_solve(problem, "xla", dtype, chunk=4)  # tpulint: disable=TPU019
     """
     assert codes_of(src) == []
+
+
+# -- TPU020: raw collectives outside the communication layer ----------------
+
+
+def lint_at(source: str, path: str, **cfg) -> list[str]:
+    config = LintConfig(**cfg) if cfg else None
+    return [
+        f.code
+        for f in lint_source(textwrap.dedent(source), path=path, config=config)
+    ]
+
+
+def test_tpu020_positive_raw_psum_outside_parallel():
+    src = """
+        import jax
+
+        def reduce(x):
+            return jax.lax.psum(x, "i")
+    """
+    findings = lint_source(textwrap.dedent(src), path="pkg/obs/history.py")
+    assert [f.code for f in findings] == ["TPU020"]
+    assert "psum" in findings[0].message
+
+
+def test_tpu020_positive_aliased_lax_and_other_collectives():
+    src = """
+        from jax import lax
+
+        def gather(x):
+            return lax.all_gather(x, "lanes")
+
+        def shift(x):
+            return lax.ppermute(x, "px", [(0, 1)])
+    """
+    assert lint_at(src, "pkg/solver/engine.py") == ["TPU020", "TPU020"]
+
+
+def test_tpu020_negative_licensed_parallel_layer():
+    src = """
+        import jax
+
+        def halo(x):
+            return jax.lax.ppermute(x, "px", [(0, 1)])
+    """
+    assert lint_at(src, "poisson_ellipse_tpu/parallel/halo.py") == []
+
+
+def test_tpu020_negative_snippet_path_stays_silent():
+    # every other rule's psum fixtures lint under "<snippet>"; TPU020
+    # cannot judge an unknown layer, so it must not cry wolf there
+    src = """
+        import jax
+        s = jax.lax.psum(x, "i")
+    """
+    assert codes_of(src) == []
+
+
+def test_tpu020_negative_non_collective_lax_call():
+    src = """
+        import jax
+
+        def f(x):
+            return jax.lax.cumsum(jax.lax.exp(x))
+    """
+    assert lint_at(src, "pkg/obs/m.py") == []
+
+
+def test_tpu020_collective_modules_config_knob():
+    src = """
+        import jax
+
+        def reduce(x):
+            return jax.lax.psum(x, "i")
+    """
+    cfg = {"collective_modules": ("*/comm/*",)}
+    assert lint_at(src, "pkg/comm/reduce.py", **cfg) == []
+    assert lint_at(src, "pkg/parallel/reduce.py", **cfg) == ["TPU020"]
+
+
+def test_tpu020_suppression_comment():
+    src = """
+        import jax
+        s = jax.lax.psum(x, "i")  # tpulint: disable=TPU020
+    """
+    assert lint_at(src, "pkg/obs/m.py") == []
+
+
+# -- suppression parsing: real comments only --------------------------------
+
+
+def test_annotation_mention_inside_a_string_is_not_live():
+    # suppressions are read from COMMENT tokens, not raw lines: a string
+    # literal documenting the syntax is not a waiver for its own line
+    src = """
+        import jax.numpy as jnp
+        HELP = "# tpulint: disable=TPU001"; x = jnp.zeros(3, dtype=jnp.float64)
+    """
+    assert codes_of(src) == ["TPU001"]
+
+
+# -- suppression audit (TPU000) ---------------------------------------------
+
+
+def audit_of(source: str, path: str = "<snippet>", **cfg) -> list[Finding]:
+    config = LintConfig(**cfg) if cfg else None
+    return audit_suppressions(textwrap.dedent(source), path=path, config=config)
+
+
+def test_audit_used_suppression_is_silent():
+    src = """
+        import jax.numpy as jnp
+        x = jnp.zeros(3, dtype=jnp.float64)  # tpulint: disable=TPU001
+    """
+    assert audit_of(src) == []
+
+
+def test_audit_stale_suppression_fires():
+    src = """
+        import jax.numpy as jnp
+        x = jnp.zeros(3, dtype=jnp.float32)  # tpulint: disable=TPU001
+    """
+    findings = audit_of(src)
+    assert [f.code for f in findings] == [AUDIT_CODE]
+    assert "TPU001" in findings[0].message
+
+
+def test_audit_standalone_covers_the_next_line():
+    src = """
+        import jax.numpy as jnp
+        # tpulint: disable=TPU001
+        x = jnp.zeros(3, dtype=jnp.float64)
+    """
+    assert audit_of(src) == []
+
+
+def test_audit_is_per_code_within_one_comment():
+    src = """
+        import jax.numpy as jnp
+        x = jnp.zeros(3, dtype=jnp.float64)  # tpulint: disable=TPU001,TPU006
+    """
+    findings = audit_of(src)
+    assert [f.code for f in findings] == [AUDIT_CODE]
+    assert "TPU006" in findings[0].message  # TPU001 is earning its keep
+
+
+def test_audit_unknown_code_always_flagged():
+    src = """
+        x = 1  # tpulint: disable=TPU999
+    """
+    findings = audit_of(src)
+    assert [f.code for f in findings] == [AUDIT_CODE]
+    assert "TPU999" in findings[0].message
+
+
+def test_audit_disable_all_judged_as_a_unit():
+    used = """
+        import jax.numpy as jnp
+        x = jnp.zeros(3, dtype=jnp.float64)  # tpulint: disable=all
+    """
+    assert audit_of(used) == []
+    stale = """
+        x = 1  # tpulint: disable=all
+    """
+    assert [f.code for f in audit_of(stale)] == [AUDIT_CODE]
+
+
+def test_audit_inactive_rule_is_not_judged():
+    src = """
+        import jax.numpy as jnp
+        x = jnp.zeros(3, dtype=jnp.float32)  # tpulint: disable=TPU001
+    """
+    # the audit cannot re-run an ignored rule, so it cannot call the
+    # annotation stale — it stays silent rather than guessing
+    assert audit_of(src, ignore=frozenset({"TPU001"})) == []
+
+
+def test_audit_ignores_doc_text_mentions():
+    src = '''
+        """Suppress with ``# tpulint: disable=TPU999`` plus a reason."""
+        x = 1
+    '''
+    assert audit_of(src) == []
+
+
+# -- SARIF round-trip -------------------------------------------------------
+
+
+def test_sarif_round_trip_preserves_findings():
+    from poisson_ellipse_tpu.analysis.sarif import (
+        findings_to_sarif,
+        sarif_findings,
+    )
+
+    findings = [
+        Finding(path="a.py", line=3, col=5, code="TPU002", message="m1"),
+        Finding(path="b.py", line=1, col=1, code="TPU006", message="m2"),
+    ]
+    doc = findings_to_sarif(
+        findings, rules={code: r.summary for code, r in RULES.items()}
+    )
+    assert doc["version"] == "2.1.0"
+    rule_ids = [r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(RULES)
+    # the reader inverts the writer exactly (JSON-string input too)
+    back = sarif_findings(json.dumps(doc))
+    assert back == [
+        (f.path, f.code, f.line, f.col, f.message) for f in findings
+    ]
+
+
+# -- baseline: accept then ratchet ------------------------------------------
+
+
+def test_baseline_accept_then_ratchet(tmp_path):
+    bl = str(tmp_path / "baseline.json")
+    old = Finding(path="a.py", line=1, col=1, code="TPU001", message="m")
+    new = Finding(path="b.py", line=2, col=1, code="TPU006", message="m")
+
+    # adoption: a missing file swallows today's debt and is written
+    kept, note = apply_baseline(bl, [old], [])
+    assert kept == [] and "accepted 1" in note
+    assert json.load(open(bl))["accepted"] == [finding_key(old)]
+
+    # accepted keys stay silent; anything new fails through
+    kept, note = apply_baseline(bl, [old, new], [])
+    assert kept == [new] and note is None
+
+    # a fixed entry is NOT shed while the run still has new findings
+    kept, note = apply_baseline(bl, [new], [])
+    assert kept == [new] and "deferred" in note
+    assert json.load(open(bl))["accepted"] == [finding_key(old)]
+
+    # ... and IS shed once the run is otherwise clean
+    kept, note = apply_baseline(bl, [], [])
+    assert kept == [] and "ratcheted 1" in note
+    assert json.load(open(bl))["accepted"] == []
+
+
+# -- CLI: --format sarif / --baseline / --audit-suppressions ----------------
+
+
+def test_cli_format_sarif(tmp_path, capsys):
+    from poisson_ellipse_tpu.lint.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\nx = jnp.zeros(3, dtype=jnp.float64)\n"
+    )
+    rc = main([str(bad), "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [r["ruleId"] for r in doc["runs"][0]["results"]] == ["TPU001"]
+
+
+def test_cli_audit_mode(tmp_path, capsys):
+    from poisson_ellipse_tpu.lint.__main__ import main
+
+    stale = tmp_path / "stale.py"
+    stale.write_text("x = 1  # tpulint: disable=TPU001\n")
+    rc = main([str(stale), "--audit-suppressions"])
+    out = capsys.readouterr().out
+    assert rc == 1 and AUDIT_CODE in out
+
+    stale.write_text("x = 1\n")
+    rc = main([str(stale), "--audit-suppressions"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "0 stale suppressions" in out
+
+
+def test_cli_baseline_flow(tmp_path, capsys):
+    from poisson_ellipse_tpu.lint.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bl = tmp_path / "bl.json"
+    bad.write_text(
+        "import jax.numpy as jnp\nx = jnp.zeros(3, dtype=jnp.float64)\n"
+    )
+    assert main([str(bad), "--baseline", str(bl)]) == 0  # adoption run
+    capsys.readouterr()
+    assert main([str(bad), "--baseline", str(bl)]) == 0  # accepted debt
+    capsys.readouterr()
+    bad.write_text("import jax.numpy as jnp\nx = jnp.zeros(3)\n")
+    assert main([str(bad), "--baseline", str(bl)]) == 0  # clean: ratchets
+    assert json.load(open(bl))["accepted"] == []

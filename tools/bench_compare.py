@@ -46,6 +46,16 @@ older artifacts predate newer keys, which must never fail the gate):
   config that measures slower than the static default (`tuned_loses`)
   or a broken registry round-trip is a regression outright
 
+- the `contracts` key (written by `--stamp`): a new round measured
+  under a violated engine-contract state is a regression outright, and
+  a report-hash change between rounds is noted — two perf numbers are
+  only comparable under the same, clean contract state
+
+`python tools/bench_compare.py --stamp BENCH_rN.json` runs the
+engine-contract matrix (`poisson_ellipse_tpu.analysis`) and embeds
+`{"contracts": {"hash", "clean"}}` into the round, so the next compare
+can tell structural drift from noise.
+
 Tolerances live in `pyproject.toml [tool.bench_compare]` (shared by the
 CLI and the driver-dryrun smoke gate); built-in defaults apply when the
 table or a key is absent. Exit codes: 0 = no regression, 1 = regression
@@ -627,18 +637,102 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
     if bool(o_at) != bool(n_at):
         notes.append("autotune: only in one round, skipped")
 
+    # the contracts key (--stamp): two perf numbers are only comparable
+    # under the same, clean engine-contract state — a new round measured
+    # under violated contracts is a regression outright, and a changed
+    # report hash means the deltas may be structural, not noise
+    o_ct, n_ct = old.get("contracts"), new.get("contracts")
+    if isinstance(o_ct, dict) and isinstance(n_ct, dict):
+        if n_ct.get("clean") is False:
+            regressions.append(Regression(
+                "contracts_clean", "contracts", 1, 0,
+                "new round measured under a violated engine-contract "
+                "state",
+            ))
+        if o_ct.get("hash") != n_ct.get("hash"):
+            notes.append(
+                "contracts: report hash changed between rounds — the "
+                "engine-contract state differs; perf deltas may be "
+                "structural, not noise"
+            )
+    elif (o_ct is None) != (n_ct is None):
+        notes.append("contracts: only in one round, skipped")
+
     return regressions, notes
+
+
+def stamp(path: str) -> int:
+    """Embed the current engine-contract state into a bench round.
+
+    Runs the full contract matrix (abstract tracing only — cheap) and
+    writes ``{"contracts": {"hash", "clean"}}`` into the record, so a
+    later compare can refuse to read perf deltas across a contract
+    change. Exit 0 when the matrix is clean, 1 when not (the stamp is
+    still written — the compare gate is what fails the round).
+    """
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read bench round {path}: {e}",
+              file=sys.stderr)
+        return 2
+    rec = data.get("parsed", data) if isinstance(data, dict) else data
+    if not isinstance(rec, dict):
+        print(f"error: {path}: not a bench record", file=sys.stderr)
+        return 2
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ROOT not in sys.path:  # script invocation: tools/ is sys.path[0]
+        sys.path.insert(0, ROOT)
+    try:
+        from poisson_ellipse_tpu.analysis import matrix
+        from poisson_ellipse_tpu.parallel.mesh import virtual_cpu_devices
+
+        # same virtual-mesh ritual as the analysis CLI: the matrix's
+        # sharded cells trace against a (1, 2) mesh, which needs more
+        # than the single default CPU device
+        virtual_cpu_devices(8)
+        report = matrix.run_matrix()
+    except Exception as e:
+        # the exit-code contract: 1 is "contracts not clean", never a
+        # crash — an unimportable/unrunnable matrix is unusable input
+        print(f"error: cannot run the contract matrix: {e}",
+              file=sys.stderr)
+        return 2
+    rec["contracts"] = {
+        "hash": matrix.report_hash(report),
+        "clean": report["clean"],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f)
+        f.write("\n")
+    state = "clean" if report["clean"] else "NOT clean"
+    print(
+        f"stamped {os.path.basename(path)}: contracts {state} "
+        f"({rec['contracts']['hash'][:12]})"
+    )
+    return 0 if report["clean"] else 1
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     as_json = "--json" in argv
     argv = [a for a in argv if a != "--json"]
+    if "--stamp" in argv:
+        argv.remove("--stamp")
+        if len(argv) != 1:
+            print(
+                "usage: python tools/bench_compare.py --stamp "
+                "BENCH_rN.json",
+                file=sys.stderr,
+            )
+            return 2
+        return stamp(argv[0])
     if len(argv) not in (0, 2):
         print(
             "usage: python tools/bench_compare.py [--json] "
-            "[OLD.json NEW.json]\n(no paths: the newest two BENCH_r*.json "
-            "rounds in the repo root)",
+            "[OLD.json NEW.json | --stamp BENCH_rN.json]\n(no paths: the "
+            "newest two BENCH_r*.json rounds in the repo root)",
             file=sys.stderr,
         )
         return 2
