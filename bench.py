@@ -1515,7 +1515,7 @@ def bench_fleet(n_requests: int = 24, lanes: int = 2,
     from poisson_ellipse_tpu.obs import metrics as obs_metrics
     from poisson_ellipse_tpu.resilience import faultinject
 
-    def run_stream(replicas: int, kill_at=None):
+    def run_stream(replicas: int, kill_at=None, rejoin_at=None):
         rng = random.Random(seed)
         faults = []
         if kill_at is not None:
@@ -1535,6 +1535,10 @@ def bench_fleet(n_requests: int = 24, lanes: int = 2,
                 router.submit(Problem(M=M, N=N),
                               request_id=f"fleet-{i:03d}")
                 router.step()
+                if (rejoin_at is not None and i >= rejoin_at
+                        and not router.rejoins
+                        and not router.replicas[0].live):
+                    router.rejoin_replica(0)
             results = router.drain()
             wall = time.perf_counter() - t0
         completed = sum(
@@ -1577,27 +1581,41 @@ def bench_fleet(n_requests: int = 24, lanes: int = 2,
         prev_sps = sps
     all_ok &= non_decreasing
 
-    # the kill round: handoff latency under a real mid-stream death
+    # the kill→rejoin round: handoff latency under a real mid-stream
+    # death, then the victim re-enters as a fresh incarnation and the
+    # kill→first-completed-solve latency of the rejoiner is the fleet's
+    # recovery-time-to-capacity number (rejoin_latency_s, p99)
     hist = obs_metrics.REGISTRY.histogram(
         obs_metrics.HANDOFF_LATENCY_SECONDS
     )
+    rejoin_hist = obs_metrics.REGISTRY.histogram(
+        obs_metrics.REJOIN_LATENCY_SECONDS
+    )
     count_before = hist.count
+    rejoin_count_before = rejoin_hist.count
+    kill_at = max(n_requests // 3, 1)
+    rejoin_at = max(2 * n_requests // 3, kill_at + 1)
     router, results, completed, _wall = run_stream(
-        2, kill_at=max(n_requests // 3, 1)
+        2, kill_at=kill_at, rejoin_at=rejoin_at
     )
     handoff_p99 = hist.quantile(0.99)
+    rejoin_p99 = rejoin_hist.quantile(0.99)
     kill_ok = (
         completed == n_requests
         and router.handoffs >= 1
         and hist.count > count_before
+        and router.rejoins >= 1
+        and rejoin_hist.count > rejoin_count_before
     )
     all_ok &= kill_ok
     note(
-        f"  [fleet] kill drill (2 replicas, kill@{max(n_requests // 3, 1)}): "
-        f"completed {completed}/{n_requests}, "
+        f"  [fleet] kill→rejoin drill (2 replicas, kill@{kill_at}, "
+        f"rejoin@{rejoin_at}): completed {completed}/{n_requests}, "
         f"{router.handoffs} handoff(s), {router.adopted_total} adopted, "
-        f"handoff p99 {handoff_p99 if handoff_p99 is None else round(handoff_p99, 5)}s "
-        + ("— OK" if kill_ok else "— HANDOFF MISS (regression)"),
+        f"{router.rejoins} rejoin(s), "
+        f"handoff p99 {handoff_p99 if handoff_p99 is None else round(handoff_p99, 5)}s, "
+        f"rejoin p99 {rejoin_p99 if rejoin_p99 is None else round(rejoin_p99, 5)}s "
+        + ("— OK" if kill_ok else "— RECOVERY MISS (regression)"),
     )
     row = {
         "rows": rows,
@@ -1605,9 +1623,13 @@ def bench_fleet(n_requests: int = 24, lanes: int = 2,
         "handoff_p99_s": (
             round(handoff_p99, 6) if handoff_p99 is not None else None
         ),
+        "rejoin_latency_s": (
+            round(rejoin_p99, 6) if rejoin_p99 is not None else None
+        ),
         "kill_completed": completed,
         "handoffs": router.handoffs,
         "adopted": router.adopted_total,
+        "rejoins": router.rejoins,
     }
     return row, all_ok
 

@@ -57,6 +57,10 @@ CONTRACT_DEFAULTS: dict = {
     "history_resident": False,
     # fmg: whole-trace ppermute budget (halos_per_fcycle) applies
     "fcycle_budget": False,
+    # fleet survivability: the kill→rejoin chaos drill's invariants
+    # (zero-lost, zero-double, no cross-epoch co-ownership, no silent
+    # starvation) hold, and the verdict is sensitive to each of them
+    "fleet_chaos": False,
 }
 
 # classical carry width: the history-off loop must keep the original
@@ -108,6 +112,11 @@ CONTRACT_KINDS = {
     "fcycle-budget": (
         "the sharded F-cycle's whole-trace ppermute total equals the "
         "halos_per_fcycle budget — no hidden exchanges"
+    ),
+    "fleet-chaos": (
+        "a kill→rejoin fleet drill completes every request exactly once "
+        "with no cross-epoch co-ownership, and the chaos verdict is "
+        "sensitive to every survivability invariant field"
     ),
 }
 
@@ -603,6 +612,90 @@ def _check_fcycle_budget(engine, spec, problem, dtype, mesh_shape, **_):
     )
 
 
+# the fleet invariant fields ChaosReport.ok must fold over — the
+# sensitivity probe poisons each one and demands the verdict flips
+_FLEET_INVARIANT_PROBES = {
+    "lost": ["chaos-0000"],
+    "double_completed": ["chaos-0000"],
+    "unclassified": ["chaos-0000"],
+    "grad_missing_payload": ["chaos-0000"],
+    "co_owned": ["chaos-0000"],
+    "starved_silent": ["batch"],
+}
+
+
+def _check_fleet_chaos(engine, spec, problem, dtype, expect=None, **_):
+    """Two prongs. (1) Verdict sensitivity: ``ChaosReport.ok`` must go
+    False when any survivability invariant field is poisoned — a verdict
+    that ignored co-ownership or silent starvation would let the chaos
+    gate rot while still reading green. (2) A live kill→rejoin drill on
+    the tiny grid must come back ok with the rejoin and handoff actually
+    executed (a drill that never exercises the ladder proves nothing).
+
+    ``expect`` (a dict of report-field overrides, applied to the live
+    drill's report before judging) is the injected-violation hook the
+    fire fixtures use.
+    """
+    import os
+    import tempfile
+
+    from poisson_ellipse_tpu.serve.chaos import ChaosReport, run_chaos
+
+    del spec, dtype
+    msgs = []
+    base = dict(
+        n_requests=1, outcomes={}, counts={}, lost=[],
+        double_completed=[], unclassified=[], replayed=0, killed=True,
+        faults_fired=0, wall_s=0.0,
+    )
+    insensitive = [
+        name
+        for name, poison in _FLEET_INVARIANT_PROBES.items()
+        if ChaosReport(**{**base, name: poison}).ok
+    ]
+    if insensitive:
+        msgs.append(
+            "ChaosReport.ok ignores invariant field(s) "
+            f"{', '.join(insensitive)} — a broken drill would read ok"
+        )
+    with tempfile.TemporaryDirectory() as tmp:
+        report = run_chaos(
+            n_requests=6, seed=0, grids=((problem.M, problem.N),),
+            chunk=2, journal_path=os.path.join(tmp, "chaos.jsonl"),
+            nan_request=None, oom_request=None,
+            replicas=2, replica_kill=2, replica_rejoin=4,
+        )
+    if expect:
+        report = dataclasses.replace(report, **dict(expect))
+    if not report.ok:
+        evidence = {
+            name: getattr(report, name)
+            for name in _FLEET_INVARIANT_PROBES
+            if getattr(report, name)
+        }
+        msgs.append(
+            f"kill→rejoin drill broke its invariants: {evidence}"
+        )
+    if report.rejoins < 1:
+        msgs.append(
+            f"drill executed {report.rejoins} rejoin(s); the ladder "
+            "never ran, so the verdict pins nothing"
+        )
+    if report.handoffs < 1:
+        msgs.append(
+            f"drill executed {report.handoffs} handoff(s); the kill "
+            "never orphaned work, so adoption went unexercised"
+        )
+    return _result(
+        "fleet-chaos", engine,
+        {"insensitive": [], "ok": True, "rejoins_min": 1,
+         "handoffs_min": 1},
+        {"insensitive": insensitive, "ok": report.ok,
+         "rejoins": report.rejoins, "handoffs": report.handoffs},
+        msgs,
+    )
+
+
 _CHECKERS = {
     "single-collective-free": _check_single_collective_free,
     "collective-cadence": _check_collective_cadence,
@@ -614,6 +707,7 @@ _CHECKERS = {
     "history-free": _check_history_free,
     "history-resident": _check_history_resident,
     "fcycle-budget": _check_fcycle_budget,
+    "fleet-chaos": _check_fleet_chaos,
 }
 
 
@@ -635,6 +729,7 @@ def contract_applies(kind: str, engine: str,
         "history-free": spec["history_resident"],
         "history-resident": spec["history_resident"],
         "fcycle-budget": spec["fcycle_budget"],
+        "fleet-chaos": spec["fleet_chaos"],
     }[kind]
 
 

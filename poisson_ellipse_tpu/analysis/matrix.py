@@ -41,6 +41,7 @@ AXIS_CONTRACTS = {
     "abft": ("abft-identity",),
     "storage": ("storage-identity", "storage-narrow"),
     "history": ("history-free", "history-resident"),
+    "fleet": ("fleet-chaos",),
 }
 AXES = tuple(AXIS_CONTRACTS)
 

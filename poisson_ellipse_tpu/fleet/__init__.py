@@ -26,6 +26,17 @@ The chaos invariants (zero lost / zero double / all classified) extend
 across replica kill, kill-during-handoff and zombie resurrection —
 ``serve.chaos.run_chaos(replicas=…)``, ``harness fleet``, and
 ``tests/test_fleet.py`` all pin them.
+
+The survivability layer (ISSUE 19) makes membership elastic and the
+coordination service a fault domain: ``FleetRouter.rejoin_replica``
+re-enters a dead replica as a fresh incarnation (archived-journal
+replay through the adoption path, warm-pool pre-warm, no cross-epoch
+co-ownership); the :class:`~.replica.LeaseStore` surface (in-process
+:class:`~.replica.FenceAuthority` default, file-backed
+:class:`~.replica.FileLeaseStore`) is injectable with outage/latency
+faults and the fleet degrades fail-safe behind a grace window; and
+multi-tenant admission classes (``ServeRequest.tenant``/``priority``)
+get per-class quotas, priority preemption and loud starvation events.
 """
 
 from poisson_ellipse_tpu.fleet.handoff import handoff_journal
@@ -33,7 +44,9 @@ from poisson_ellipse_tpu.fleet.replica import (
     DEFAULT_LEASE_S,
     FenceAuthority,
     FencingToken,
+    FileLeaseStore,
     Lease,
+    LeaseStore,
     Replica,
     StaleLeaseError,
 )
@@ -44,8 +57,10 @@ __all__ = [
     "DEFAULT_LEASE_S",
     "FenceAuthority",
     "FencingToken",
+    "FileLeaseStore",
     "FleetRouter",
     "Lease",
+    "LeaseStore",
     "Replica",
     "StaleLeaseError",
     "handoff_journal",

@@ -42,7 +42,8 @@ from poisson_ellipse_tpu.serve.journal import RequestJournal
 
 
 def handoff_journal(journal_path, survivors, clock=time.monotonic,
-                    dead_replica: int | None = None) -> tuple[int, int]:
+                    dead_replica: int | None = None,
+                    skip=None) -> tuple[int, int]:
     """Replay a dead replica's journal into ``survivors``' admission.
 
     ``journal_path`` is reopened from disk — SIGKILL semantics: whatever
@@ -54,11 +55,32 @@ def handoff_journal(journal_path, survivors, clock=time.monotonic,
     abandoning sweep's latency sample would pull the recovery-time p99
     toward zero, and "handoffs >= 1" gates must not be satisfiable by
     a no-op.
+
+    Adoption is CLASS-AWARE: unfinished requests replay
+    highest-priority first (earliest deadline within a class), so a
+    dying replica's important work is re-owned before recovery spends
+    time on batch work — under a second failure mid-handoff, what got
+    adopted is what mattered most.
+
+    ``skip`` (a predicate on the rebuilt request) drops entries some
+    LIVE owner already holds — the REJOIN handshake passes it, because
+    a journal archived at death time still lists requests the death
+    handoff moved to survivors, and re-adopting those would co-own a
+    request across epochs. Skipped entries count as neither adopted
+    nor abandoned (they are owned elsewhere, not lost).
     """
     t0 = clock()
     now = clock()
     ledger = RequestJournal(journal_path)
-    reqs = ledger.unfinished(now)
+    reqs = sorted(
+        ledger.unfinished(now),
+        key=lambda r: (
+            -r.priority,
+            r.deadline if r.deadline is not None else float("inf"),
+        ),
+    )
+    if skip is not None:
+        reqs = [r for r in reqs if not skip(r)]
     adopted = 0
     abandoned = 0
     for req in reqs:

@@ -33,11 +33,19 @@ fleet wraps it; it does not fork it.
 
 from __future__ import annotations
 
+import json
+import os
+import tempfile
+import threading
 import time
 from typing import Callable, Optional
 
 from poisson_ellipse_tpu.obs import metrics as obs_metrics
 from poisson_ellipse_tpu.obs import trace as obs_trace
+from poisson_ellipse_tpu.resilience.errors import (
+    LeaseStoreCorruptError,
+    LeaseStoreOutageError,
+)
 from poisson_ellipse_tpu.serve.journal import RequestJournal
 from poisson_ellipse_tpu.serve.scheduler import Scheduler
 
@@ -71,34 +79,199 @@ class StaleLeaseError(RuntimeError):
     silent."""
 
 
-class FenceAuthority:
-    """The fleet's epoch registry: one current epoch per replica id.
+class LeaseStore:
+    """The fleet's epoch registry AND its own fault domain.
 
-    Stands in for the lease service a multi-host deployment would put
-    in a shared store (etcd/Chubby-shaped); in-process the semantics are
-    identical — :meth:`issue` mints a token at a fresh epoch,
-    :meth:`fence` advances the epoch so every outstanding token goes
-    stale atomically, and :meth:`valid` is the single comparison every
-    fenced write reduces to."""
+    One current epoch per replica id; :meth:`issue` mints a token at a
+    fresh epoch, :meth:`fence` advances the epoch so every outstanding
+    token goes stale atomically, and :meth:`valid` is the single
+    comparison every fenced write reduces to.
 
-    def __init__(self):
+    The store is the stand-in for the lease service a multi-host
+    deployment would put behind etcd/Chubby — which means the store
+    itself can fail, and the failure semantics are the design:
+
+    - operations that must ROUND-TRIP to the store (:meth:`issue`,
+      :meth:`fence`, :meth:`ping`, :meth:`refresh`) pass through
+      :meth:`_gate`, where injected latency (``delay_for`` /
+      ``faultinject.lease_store_latency``) and outage (``fail_for`` /
+      ``faultinject.lease_store_outage``) apply; during an outage they
+      raise :class:`~poisson_ellipse_tpu.resilience.errors.LeaseStoreOutageError`.
+    - :meth:`valid` is deliberately NOT gated: it answers from the
+      local cache mirror, so replicas holding unexpired leases keep
+      serving (and zombies keep getting rejected) straight through a
+      store outage. The fleet degrades on *membership change*, never on
+      the steady-state write path.
+
+    ``on_delay`` is the sleep hook injected latency uses (the router
+    points it at its own ``idle`` so FakeClock tests stay honest).
+    A ``threading.Lock`` serialises epoch mutation: concurrent
+    issue/revoke interleavings must observe strictly monotonic epochs.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self.clock = clock
+        self.on_delay: Optional[Callable[[float], None]] = None
+        self._outage_until = 0.0
+        self._latency_s = 0.0
+        self._lock = threading.Lock()
         self._epoch: dict[int, int] = {}
+
+    # -- fault surface (faultinject.lease_store_* lands here) ---------------
+
+    def fail_for(self, duration_s: float) -> None:
+        """Arm an outage: every gated round-trip raises until
+        ``duration_s`` of store-clock time passes."""
+        self._outage_until = self.clock() + float(duration_s)
+
+    def delay_for(self, delay_s: float) -> None:
+        """Arm sticky latency: every gated round-trip stalls
+        ``delay_s`` first (the slow-quorum drill)."""
+        self._latency_s = max(0.0, float(delay_s))
+
+    def _gate(self, op: str) -> None:
+        if self._latency_s > 0.0:
+            (self.on_delay or time.sleep)(self._latency_s)
+        if self.clock() < self._outage_until:
+            raise LeaseStoreOutageError(
+                f"lease store unreachable: '{op}' refused for another "
+                f"{self._outage_until - self.clock():.3f}s"
+            )
+
+    def ping(self) -> None:
+        """A gated no-op round-trip: the router's recovery probe."""
+        self._gate("ping")
+
+    # -- the epoch registry -------------------------------------------------
 
     def issue(self, replica_id: int) -> "FencingToken":
         """Mint the replica's token at a fresh epoch (re-issuing — a
-        restarted replica under the same id — bumps the epoch, so the
-        dead incarnation's token is stale from the first write)."""
-        self._epoch[replica_id] = self._epoch.get(replica_id, 0) + 1
-        return FencingToken(self, replica_id, self._epoch[replica_id])
+        restarted or REJOINING replica under the same id — bumps the
+        epoch, so the dead incarnation's token is stale from the first
+        write). Round-trips: raises during an outage, which is exactly
+        right — a fleet that cannot reach its lease store must not
+        mint new incarnations."""
+        self._gate("issue")
+        with self._lock:
+            epoch = self._epoch.get(replica_id, 0) + 1
+            self._epoch[replica_id] = epoch
+            self._persist()
+        return FencingToken(self, replica_id, epoch)
 
     def fence(self, replica_id: int) -> None:
         """Revoke every outstanding token of ``replica_id`` (declared
         dead): the epoch advances, so the zombie's next fenced write
-        raises instead of landing."""
-        self._epoch[replica_id] = self._epoch.get(replica_id, 0) + 1
+        raises instead of landing. Round-trips (raises during an
+        outage): the router defers the death until the store answers."""
+        self._gate("fence")
+        with self._lock:
+            self._epoch[replica_id] = self._epoch.get(replica_id, 0) + 1
+            self._persist()
 
     def valid(self, replica_id: int, epoch: int) -> bool:
+        """UNGATED — answers from the local cache mirror (see class
+        docstring): journal writes validate at full speed through an
+        outage."""
         return self._epoch.get(replica_id) == epoch
+
+    def refresh(self) -> None:
+        """Re-read persisted state after an outage (gated). In-process
+        stores have nothing to re-read; the file-backed impl reloads
+        and classifies corruption."""
+        self._gate("refresh")
+
+    def current_epoch(self, replica_id: int) -> int:
+        return self._epoch.get(replica_id, 0)
+
+    def _persist(self) -> None:
+        """Write-through hook, called under ``_lock`` after every epoch
+        mutation. In-process: nothing to do."""
+
+
+class FenceAuthority(LeaseStore):
+    """The in-process :class:`LeaseStore` — the fleet default.
+
+    Kept under its PR 12 name: the epoch registry semantics are
+    unchanged, it just sits on the pluggable store surface now (gated
+    round-trips, fault hooks, locked mutation) so chaos can partition
+    the coordination service out from under a live fleet."""
+
+
+class FileLeaseStore(LeaseStore):
+    """A file-backed :class:`LeaseStore`: the cross-process stand-in.
+
+    Epochs persist as one JSON document written atomically (temp file
+    in the same directory, fsync, then ``os.replace`` — the
+    ``serve.journal`` discipline, so a crash mid-write leaves the OLD
+    complete state, never a torn one). Reads that DO find a torn or
+    truncated document — an external writer without the atomic
+    discipline, bit rot — raise
+    :class:`~poisson_ellipse_tpu.resilience.errors.LeaseStoreCorruptError`
+    instead of re-initialising: silently resetting epochs would
+    validate a fenced zombie's stale token again, which is split-brain
+    by construction. A missing file is a FRESH store (first boot), not
+    corruption."""
+
+    def __init__(self, path, clock: Callable[[], float] = time.monotonic):
+        super().__init__(clock=clock)
+        self.path = os.fspath(path)
+        self._epoch = self._load()
+
+    def _load(self) -> dict[int, int]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                raw = fh.read()
+        except FileNotFoundError:
+            return {}
+        try:
+            doc = json.loads(raw)
+        except ValueError as exc:
+            raise LeaseStoreCorruptError(
+                f"lease store {self.path} failed to parse ({exc}): torn "
+                "write or truncation; refusing to re-initialise epochs "
+                "(a reset would re-validate fenced tokens — split-brain)"
+            ) from exc
+        if not isinstance(doc, dict) or not isinstance(doc.get("epoch"), dict):
+            raise LeaseStoreCorruptError(
+                f"lease store {self.path} parsed but lacks the epoch "
+                "table; refusing to re-initialise"
+            )
+        return {int(k): int(v) for k, v in doc["epoch"].items()}
+
+    def _persist(self) -> None:
+        doc = {
+            "v": 1,
+            "epoch": {str(k): v for k, v in sorted(self._epoch.items())},
+        }
+        dirname = os.path.dirname(self.path) or "."
+        fd, tmp = tempfile.mkstemp(
+            dir=dirname, prefix=".lease-store.", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(doc, sort_keys=True))
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def refresh(self) -> None:
+        """Reload the persisted epoch table (gated): the router calls
+        this first thing at outage recovery so every lease re-validates
+        against what the STORE says, not what this process remembers.
+        Epochs only ever advance, so the merged view takes the max of
+        disk and cache per replica."""
+        self._gate("refresh")
+        with self._lock:
+            disk = self._load()
+            for rid, epoch in disk.items():
+                if epoch > self._epoch.get(rid, 0):
+                    self._epoch[rid] = epoch
 
 
 class FencingToken:
@@ -109,7 +282,7 @@ class FencingToken:
 
     __slots__ = ("authority", "replica_id", "epoch")
 
-    def __init__(self, authority: FenceAuthority, replica_id: int,
+    def __init__(self, authority: "LeaseStore", replica_id: int,
                  epoch: int):
         self.authority = authority
         self.replica_id = replica_id
@@ -183,7 +356,7 @@ class Replica:
         self,
         replica_id: int,
         journal_path,
-        authority: FenceAuthority,
+        authority: LeaseStore,
         clock: Callable[[], float] = time.monotonic,
         lease_s: float = DEFAULT_LEASE_S,
         **scheduler_kw,

@@ -34,10 +34,33 @@ Drain (``shutdown()``) is the graceful inverse: every replica stops
 admitting (``Scheduler.begin_drain``), finishes what it owns, flushes
 metrics — the SIGTERM path of ``harness serve``/``harness fleet`` rides
 this hook.
+
+Two survivability layers ride on the same loop:
+
+- **REJOIN** (``rejoin_replica``) makes membership elastic upward: a
+  dead or zombie-fenced replica re-enters as a FRESH incarnation — new
+  epoch from the lease store, its old journal archived and replayed
+  through the handoff adoption path *before* the new incarnation takes
+  traffic (anything a live owner already holds is skipped, so no
+  request is ever co-owned across epochs), and its batch contexts
+  pre-warmed from the router's observed shape mix so the first real
+  requests land warm.
+- **Lease-store outage handling**: the store itself is a fault domain
+  (``faultinject.lease_store_outage``). During an outage the fleet is
+  fail-safe, not fail-open — replicas holding unexpired leases keep
+  serving (epoch validation answers from the local cache), deaths that
+  need a fence round-trip are DEFERRED until the store answers, and new
+  admissions are allowed only within ``store_grace_s`` of the outage
+  start; past the grace window every submit raises a classified
+  ``FleetUnavailableError`` whose ``retry_after_s`` backs off
+  exponentially (capped — the TPU014 discipline). Recovery re-validates
+  every live lease against the store before admission resumes, then
+  completes the deferred fences and handoffs.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Optional
 
@@ -45,14 +68,19 @@ from poisson_ellipse_tpu.fleet.handoff import handoff_journal
 from poisson_ellipse_tpu.fleet.replica import (
     DEFAULT_LEASE_S,
     FenceAuthority,
+    LeaseStore,
     Replica,
     routing_load_key,
 )
 from poisson_ellipse_tpu.models.problem import Problem
 from poisson_ellipse_tpu.obs import metrics as obs_metrics
 from poisson_ellipse_tpu.obs import trace as obs_trace
-from poisson_ellipse_tpu.resilience.errors import FleetUnavailableError
+from poisson_ellipse_tpu.resilience.errors import (
+    FleetUnavailableError,
+    LeaseStoreError,
+)
 from poisson_ellipse_tpu.resilience.faultinject import (
+    LEASE_STORE_KINDS,
     REPLICA_KINDS,
     FaultPlan,
 )
@@ -66,6 +94,25 @@ from poisson_ellipse_tpu.serve.request import (
 # fraction of the lease length left below which a replica is SUSPECTED
 # (new requests hedge around it); 0 disables hedging
 DEFAULT_HEDGE_FRAC = 0.25
+
+# store_grace_s default, in lease lengths: how long past an outage start
+# the fleet keeps admitting on unexpired leases before failing safe
+DEFAULT_STORE_GRACE_LEASES = 2.0
+
+# cap on the outage-refusal exponential backoff, in lease lengths (the
+# TPU014 discipline: bounded, never a runaway doubling)
+STORE_BACKOFF_CAP_LEASES = 16.0
+
+# how many distinct shape-mix buckets a rejoin pre-warms (most-observed
+# first): enough to cover a typical serving mix without compiling the
+# long tail on the rejoin path
+DEFAULT_PREWARM_BUCKETS = 4
+
+# retired incarnations kept addressable (duplicate-gate memory, live
+# counters); older ones are evicted with their counters folded into
+# aggregates — a fleet rejoining forever must not accumulate schedulers
+# (the TPU012 bound, same windowed idiom as obs.metrics.Histogram)
+RETIRED_INCARNATIONS_KEPT = 64
 
 
 class FleetRouter:
@@ -88,10 +135,10 @@ class FleetRouter:
         lease_s: float = DEFAULT_LEASE_S,
         hedge_frac: float = DEFAULT_HEDGE_FRAC,
         faults: Optional[FaultPlan] = None,
+        lease_store: Optional[LeaseStore] = None,
+        store_grace_s: Optional[float] = None,
         **scheduler_kw,
     ):
-        import os
-
         if replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         if journal_dir is None:
@@ -105,7 +152,21 @@ class FleetRouter:
         self.lease_s = lease_s
         self.hedge_frac = hedge_frac
         self.faults = faults if faults is not None else FaultPlan()
-        self.authority = FenceAuthority()
+        # the pluggable lease store (module docstring): in-process by
+        # default; its injected-latency stalls run through the router's
+        # OWN idle so FakeClock tests stay honest
+        self.authority: LeaseStore = (
+            lease_store if lease_store is not None
+            else FenceAuthority(clock=clock)
+        )
+        self.authority.on_delay = idle
+        self.store_grace_s = (
+            DEFAULT_STORE_GRACE_LEASES * lease_s
+            if store_grace_s is None else store_grace_s
+        )
+        # rejoin needs to rebuild a Replica with the SAME scheduler
+        # construction the fleet was born with
+        self._scheduler_kw = dict(scheduler_kw)
         self.replicas: list[Replica] = [
             Replica(
                 i,
@@ -137,6 +198,33 @@ class FleetRouter:
         # last-writer-overwritten in the results dict
         self._delivered_ids: set[str] = set()
         self.double_delivered: list[str] = []
+        # -- survivability state (module docstring) --
+        # lease-store outage machine: when the outage started (None =
+        # store healthy), how many admissions were refused past the
+        # grace window (the backoff exponent), and the deaths whose
+        # fence round-trip the outage deferred
+        self._outage_since: Optional[float] = None
+        self._outage_refusals = 0
+        self._deferred_dead: list[tuple[Replica, str, bool]] = []
+        # rejoin bookkeeping: when each replica id was last declared
+        # dead, the rejoin-latency measurements armed by rejoin_replica
+        # (observed at the first completed delivery from the rejoined
+        # incarnation), and the observed shape mix that seeds the
+        # rejoiner's warm pool
+        self.rejoins = 0
+        self._killed_at: dict[int, float] = {}
+        self._rejoin_pending: dict[int, float] = {}
+        self._shape_mix: dict[tuple, list] = {}
+        # incarnations replaced by a rejoin: out of the routing set but
+        # kept addressable — their journals' finished-id memory still
+        # backs the duplicate gate, and their counters still feed the
+        # fleet-wide accounting. Bounded (RETIRED_INCARNATIONS_KEPT):
+        # evicted incarnations fold their counters into the aggregates
+        # below so the accounting stays exact even when the duplicate-
+        # gate memory of ancient epochs ages out
+        self._retired: list[Replica] = []
+        self._retired_drain_sheds = 0
+        self._retired_starvation: tuple[dict, dict] = ({}, {})
 
     # -- liveness ------------------------------------------------------------
 
@@ -198,15 +286,38 @@ class FleetRouter:
         #    double-completion evidence the ledger exists to keep
         for rid, res in rep.scheduler.collect().items():
             self._deliver(rid, res, rep.replica_id)
+        self._killed_at[rep.replica_id] = self.clock()
         # 2. fence FIRST: from this instant the (possible) zombie's
         #    journal writes raise, so the survivors own the requests
-        #    exclusively before any of them is re-admitted
-        self.authority.fence(rep.replica_id)
+        #    exclusively before any of them is re-admitted. The fence is
+        #    a store ROUND-TRIP: during a lease-store outage it raises,
+        #    and the death is DEFERRED — the replica stops being stepped
+        #    (dead=True) but its fence+handoff wait for the store, so no
+        #    survivor adopts work the un-fenced token could still
+        #    complete (fail-safe: ownership never splits)
+        try:
+            self.authority.fence(rep.replica_id)
+        except LeaseStoreError as exc:
+            self._enter_outage(exc)
+            rep.dead = True
+            if zombie:
+                self.zombies[rep.replica_id] = rep
+            self._deferred_dead.append((rep, cause, zombie))
+            obs_trace.event(
+                "fleet:death-deferred",
+                replica=rep.replica_id,
+                cause=cause,
+                deferred=len(self._deferred_dead),
+            )
+            return
         rep.dead = True
         if zombie:
             # the process object lives on (lease expiry, not SIGKILL):
             # keep it addressable for the resurrection drill
             self.zombies[rep.replica_id] = rep
+        self._finish_death(rep, cause)
+
+    def _finish_death(self, rep: Replica, cause: str) -> None:
         # 3. hand off the journal to the survivors — every LIVE replica
         #    is a candidate (handoff.py prefers non-draining ones but
         #    falls back to draining: already-acknowledged fleet work is
@@ -251,7 +362,9 @@ class FleetRouter:
         everywhere else), or the last-landed index between arrivals
         (router steps never fire a fault early)."""
         for fault in self.faults.faults:
-            if (fault.fired or fault.kind not in REPLICA_KINDS
+            if (fault.fired
+                    or (fault.kind not in REPLICA_KINDS
+                        and fault.kind not in LEASE_STORE_KINDS)
                     or arrival_index < fault.at_request):
                 continue
             fault.fired = True
@@ -266,20 +379,117 @@ class FleetRouter:
                 rep.hung_until = self.clock() + fault.delay_s
             elif fault.kind == "lease_clock_skew" and rep is not None:
                 rep.lease.skew_s = fault.skew_s
+            elif fault.kind == "lease_store_outage":
+                self.authority.fail_for(fault.delay_s)
+                self._enter_outage(None)
+            elif fault.kind == "lease_store_latency":
+                self.authority.delay_for(fault.delay_s)
+
+    # -- lease-store outage machine ------------------------------------------
+
+    def _enter_outage(self, exc: Optional[BaseException]) -> None:
+        if self._outage_since is not None:
+            return
+        self._outage_since = self.clock()
+        self._outage_refusals = 0
+        obs_trace.event(
+            "fleet:lease-store-outage",
+            grace_s=round(self.store_grace_s, 6),
+            detail=None if exc is None else str(exc),
+        )
+
+    def _store_gate(self) -> None:
+        """Probe the store once per boundary while an outage is on;
+        the first answered ping runs recovery."""
+        if self._outage_since is None:
+            return
+        try:
+            self.authority.ping()
+        except LeaseStoreError:
+            return
+        self._recover_store()
+
+    def _recover_store(self) -> None:
+        """The outage-exit protocol, in the order that keeps ownership
+        single: (1) reload what the STORE says (``refresh`` — a
+        file-backed store may have been advanced by another process),
+        (2) re-validate every live replica's lease epoch against it —
+        any replica the store no longer recognises is declared dead
+        (fence now round-trips) BEFORE admission resumes, (3) complete
+        the deferred deaths' fences and handoffs, (4) clear the outage
+        state. Only then does ``submit`` stop refusing."""
+        outage_s = self.clock() - (self._outage_since or 0.0)
+        self.authority.refresh()
+        revoked = [
+            rep for rep in self.live_replicas()
+            if not self.authority.valid(rep.replica_id, rep.token.epoch)
+        ]
+        for rep in revoked:
+            self._declare_dead(
+                rep, cause="lease-revoked-during-outage", zombie=True
+            )
+        deferred, self._deferred_dead = self._deferred_dead, []
+        for rep, cause, zombie in deferred:
+            self.authority.fence(rep.replica_id)
+            self._finish_death(rep, cause)
+        self._outage_since = None
+        self._outage_refusals = 0
+        obs_trace.event(
+            "fleet:lease-store-recovered",
+            outage_s=round(outage_s, 6),
+            revalidated=len(self.live_replicas()),
+            revoked=[r.replica_id for r in revoked],
+            deferred_deaths=len(deferred),
+        )
+
+    def _refuse_past_grace(self) -> None:
+        """The fail-safe admission stance: inside the grace window the
+        fleet keeps admitting on unexpired leases; past it, every
+        submit raises classified exit-9 backpressure whose hint backs
+        off exponentially, capped (TPU014 — a client honouring the
+        hints never hammers a down store, and never waits unboundedly
+        either)."""
+        if self._outage_since is None:
+            return
+        elapsed = self.clock() - self._outage_since
+        if elapsed <= self.store_grace_s:
+            return
+        retry_after = min(
+            self.lease_s * (2 ** self._outage_refusals),
+            STORE_BACKOFF_CAP_LEASES * self.lease_s,
+        )
+        self._outage_refusals += 1
+        obs_trace.event(
+            "fleet:lease-store-reject",
+            outage_s=round(elapsed, 6),
+            retry_after_s=round(retry_after, 6),
+        )
+        raise FleetUnavailableError(
+            "lease store unreachable past the grace window "
+            f"({elapsed:.3f}s > {self.store_grace_s:.3f}s): admission "
+            "is fail-safe during a coordination outage (resubmit after "
+            "the hint; serving of already-admitted work continues)",
+            retry_after_s=retry_after,
+        )
 
     # -- admission -----------------------------------------------------------
 
     def submit(self, problem: Problem, deadline_s: float | None = None,
                max_retries: int | None = None,
-               request_id: str | None = None) -> Optional[ServeResult]:
+               request_id: str | None = None,
+               tenant: str = "default",
+               priority: int = 1) -> Optional[ServeResult]:
         """Route one request (same surface as ``Scheduler.submit``).
 
         Returns ``None`` on acceptance, the terminal shed when EVERY
         live replica refused (minimum ``retry_after_s``), and raises
         :class:`FleetUnavailableError` when no replica can admit at
-        all — loud, classified, never a hang."""
+        all — or when a lease-store outage has outlived the grace
+        window — loud, classified, never a hang."""
         self._apply_replica_faults(self._arrivals)
         self._arrivals += 1
+        self._store_gate()
+        self._refuse_past_grace()
         self.check_leases()
         now = self.clock()
         if request_id is not None and self._knows(request_id):
@@ -298,6 +508,7 @@ class FleetRouter:
                 retry_after_s=self.lease_s,
             )
         key = warm_affinity_key(problem.M, problem.N, problem.norm)
+        self._note_shape(key, problem)
         healthy = [r for r in candidates if not self._suspect(r, now)]
         hedged = healthy if healthy else candidates
         if healthy and len(healthy) < len(candidates):
@@ -321,7 +532,7 @@ class FleetRouter:
         for rep in order:
             shed = rep.scheduler.submit(
                 problem, deadline_s=deadline_s, max_retries=max_retries,
-                request_id=rid,
+                request_id=rid, tenant=tenant, priority=priority,
             )
             if shed is None:
                 obs_trace.event(
@@ -360,6 +571,202 @@ class FleetRouter:
         )
         return result
 
+    def _note_shape(self, key, problem: Problem) -> None:
+        """Track the observed shape mix (affinity key → count + an
+        exemplar problem): the rejoin handshake pre-warms a fresh
+        incarnation from the most-observed buckets."""
+        entry = self._shape_mix.get(key)
+        if entry is None:
+            self._shape_mix[key] = [1, problem]
+        else:
+            entry[0] += 1
+
+    # -- rejoin ---------------------------------------------------------------
+
+    def rejoin_replica(
+        self, replica_id: int,
+        prewarm_buckets: int = DEFAULT_PREWARM_BUCKETS,
+    ) -> Replica:
+        """Re-enter a dead (or zombie-fenced) replica as a FRESH
+        incarnation — the rejoin ladder, in order:
+
+        1. **fresh epoch** — the lease store :meth:`~.replica.LeaseStore.issue`
+           round-trip mints the new incarnation's token (the old one
+           stays fenced forever). During a store outage this raises and
+           the rejoin is refused classified — a fleet that cannot reach
+           its coordination service must not grow membership.
+        2. **journal archive + replay** — the dead incarnation's ledger
+           is renamed aside (``<journal>.e<old_epoch>``) and replayed
+           through the handoff adoption path BEFORE the new incarnation
+           is routable; anything a live owner already holds (or that
+           was already delivered terminally) is skipped, so no request
+           is ever co-owned across epochs. The new incarnation starts
+           its own journal empty at the original path.
+        3. **warm-pool pre-warm** — the rejoiner builds batch contexts
+           for the router's most-observed shape buckets, so its first
+           real requests land warm instead of paying cold compiles.
+        4. **take traffic** — only now does the incarnation replace the
+           dead one in the routing set (``fleet:rejoin`` event with the
+           incarnation epoch pair).
+
+        Returns the new :class:`~.replica.Replica`. The kill→first
+        completed solve latency of the rejoined replica is observed
+        into ``rejoin_latency_seconds`` at delivery time."""
+        rep = self._by_id(replica_id)
+        if rep is None:
+            raise ValueError(f"no replica {replica_id} in this fleet")
+        if rep.live:
+            raise ValueError(
+                f"replica {replica_id} is live: only a dead or fenced "
+                "replica can rejoin (drain it or kill it first)"
+            )
+        self._store_gate()
+        old_epoch = rep.token.epoch
+        idx = self.replicas.index(rep)
+        archive = None
+        if os.path.exists(rep.journal_path):
+            archive = f"{rep.journal_path}.e{old_epoch}"
+            os.replace(rep.journal_path, archive)
+        try:
+            new_rep = Replica(
+                replica_id,
+                rep.journal_path,
+                self.authority,
+                clock=self.clock,
+                lease_s=self.lease_s,
+                faults=self.faults,
+                **self._scheduler_kw,
+            )
+        except LeaseStoreError as exc:
+            if archive is not None:
+                # undo the archive: the dead incarnation's ledger stays
+                # the durable truth until a rejoin actually happens
+                os.replace(archive, rep.journal_path)
+            self._enter_outage(exc)
+            raise FleetUnavailableError(
+                f"replica {replica_id} cannot rejoin during a "
+                "lease-store outage: minting a fresh incarnation needs "
+                "the store (retry after the hint)",
+                retry_after_s=self.lease_s,
+            ) from exc
+        adopted = abandoned = 0
+        if archive is not None:
+            adopted, abandoned = handoff_journal(
+                archive,
+                [new_rep] + [
+                    r for r in self.replicas if r.live and r is not rep
+                ],
+                clock=self.clock,
+                dead_replica=replica_id,
+                skip=self._owned_elsewhere(rep),
+            )
+            if adopted > 0:
+                self.handoffs += 1
+            self.adopted_total += adopted
+        warmed = 0
+        mix = sorted(
+            self._shape_mix.items(),
+            key=lambda kv: (-kv[1][0], repr(kv[0])),
+        )
+        for _key, (_count, problem) in mix[:prewarm_buckets]:
+            new_rep.scheduler.prewarm(problem)
+            warmed += 1
+        # the old incarnation leaves the routing set only now — its
+        # counters (drain sheds, starvation episodes) stay reachable
+        # for the chaos report's accounting
+        self._retired.append(rep)
+        for old in self._retired[:-RETIRED_INCARNATIONS_KEPT]:
+            self._retired_drain_sheds += old.scheduler.drain_sheds
+            episodes, announced = self._retired_starvation
+            for tenant, n in old.scheduler.queue.starvation_episodes.items():
+                episodes[tenant] = episodes.get(tenant, 0) + n
+            for tenant, n in old.scheduler.queue.starvation_announced.items():
+                announced[tenant] = announced.get(tenant, 0) + n
+        del self._retired[:-RETIRED_INCARNATIONS_KEPT]
+        self.replicas[idx] = new_rep
+        self.rejoins += 1
+        killed_at = self._killed_at.get(replica_id)
+        if killed_at is not None:
+            self._rejoin_pending[replica_id] = killed_at
+        obs_metrics.counter(obs_metrics.FLEET_REJOIN_TOTAL).inc()
+        obs_trace.event(
+            "fleet:rejoin",
+            replica=replica_id,
+            old_epoch=old_epoch,
+            new_epoch=new_rep.token.epoch,
+            adopted=adopted,
+            abandoned=abandoned,
+            prewarmed=warmed,
+        )
+        return new_rep
+
+    def _owned_elsewhere(self, old_rep: Replica):
+        """The rejoin replay's skip predicate: True when some LIVE
+        replica owns the id, or it was already delivered terminally —
+        re-adopting either would co-own a request across epochs. The
+        old incarnation itself is excluded (its in-memory journal
+        remembers everything it ever admitted, which would skip the
+        whole archive)."""
+        def skip(req) -> bool:
+            rid = req.request_id
+            if rid in self.results or rid in self._delivered_ids:
+                return True
+            return any(
+                r.scheduler.owns_request(rid)
+                for r in self.replicas
+                if r is not old_rep and r.live
+            )
+        return skip
+
+    # -- fleet-wide accounting (the chaos report reads these) ----------------
+
+    def _all_incarnations(self) -> list[Replica]:
+        out: list[Replica] = []
+        for rep in [*self.replicas, *self.zombies.values(), *self._retired]:
+            if all(rep is not seen for seen in out):
+                out.append(rep)
+        return out
+
+    def drain_shed_total(self) -> int:
+        """Redirect sheds issued by draining schedulers fleet-wide —
+        every incarnation ever routed to, dead and retired included:
+        those sheds are unrecorded by design (``Scheduler.begin_drain``)
+        and this count is what keeps the chaos report's zero-lost
+        accounting provable for a replica killed mid-drain."""
+        return self._retired_drain_sheds + sum(
+            rep.scheduler.drain_sheds for rep in self._all_incarnations()
+        )
+
+    def starvation_counts(self) -> tuple[dict, dict]:
+        """Fleet-wide (episodes, announced) per tenant. Any tenant with
+        episodes > announced starved SILENTLY — the chaos invariant
+        violation."""
+        folded_ep, folded_an = self._retired_starvation
+        episodes: dict[str, int] = dict(folded_ep)
+        announced: dict[str, int] = dict(folded_an)
+        for rep in self._all_incarnations():
+            q = rep.scheduler.queue
+            for tenant, n in q.starvation_episodes.items():
+                episodes[tenant] = episodes.get(tenant, 0) + n
+            for tenant, n in q.starvation_announced.items():
+                announced[tenant] = announced.get(tenant, 0) + n
+        return episodes, announced
+
+    def audit_ownership(self) -> list[str]:
+        """Ids LIVE-owned by more than one live replica right now —
+        the cross-epoch co-ownership violation. Must always be empty:
+        fence-before-handoff and the rejoin skip predicate exist to
+        keep it so; the chaos loop calls this at every boundary and
+        accumulates any evidence."""
+        owner: dict[str, int] = {}
+        dups: set[str] = set()
+        for rep in self.live_replicas():
+            for rid in rep.scheduler.owned_live_ids():
+                if rid in owner and owner[rid] != rep.replica_id:
+                    dups.add(rid)
+                owner[rid] = rep.replica_id
+        return sorted(dups)
+
     def _knows(self, request_id: str) -> bool:
         """Fleet-wide ownership of an id — DEAD replicas included: a
         since-killed replica's in-memory journal still remembers what
@@ -377,7 +784,7 @@ class FleetRouter:
             return True
         return any(
             rep.scheduler.owns_request(request_id)
-            for rep in self.replicas
+            for rep in self._all_incarnations()
         )
 
     # -- the fleet loop ------------------------------------------------------
@@ -396,6 +803,7 @@ class FleetRouter:
         would. A hung replica skips the sweep, which is what lets its
         lease expire while the process lives (the zombie drill)."""
         self._apply_replica_faults(self._arrivals - 1)
+        self._store_gate()
         self.check_leases()
         working = False
         for rep in self.live_replicas():
@@ -421,6 +829,22 @@ class FleetRouter:
                 r.queue_depth() or r.in_flight()
                 for r in self.live_replicas()
             ):
+                if self._deferred_dead and self._pending_anywhere():
+                    # deferred deaths hold journaled work hostage until
+                    # the store answers the fence: idle in lease
+                    # fractions and keep probing (step's _store_gate).
+                    # An injected outage is finite; a permanently dead
+                    # store lands on the classified max_steps backstop
+                    steps += 1
+                    if steps > max_steps:
+                        raise FleetUnavailableError(
+                            "lease store outage outlived the drain: "
+                            "deferred handoffs could never complete "
+                            "(exit 9)",
+                            retry_after_s=self.lease_s,
+                        )
+                    self.idle(self.lease_s / 10)
+                    continue
                 if not self.live_replicas() and self._pending_anywhere():
                     raise FleetUnavailableError(
                         "every replica died with requests still "
@@ -491,6 +915,18 @@ class FleetRouter:
             )
         self._delivered_ids.add(rid)
         self.results[rid] = res
+        if (res.outcome == "completed"
+                and replica_id in self._rejoin_pending):
+            # the rejoin-latency contract: kill → FIRST completed solve
+            # delivered by the rejoined incarnation
+            latency = self.clock() - self._rejoin_pending.pop(replica_id)
+            obs_metrics.histogram(
+                obs_metrics.REJOIN_LATENCY_SECONDS
+            ).observe(latency)
+            obs_trace.event(
+                "fleet:rejoin-first-solve", replica=replica_id,
+                latency_s=round(latency, 6),
+            )
 
     def collect(self) -> dict[str, ServeResult]:
         """Hand off and evict the merged results (the

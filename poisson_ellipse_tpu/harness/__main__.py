@@ -1259,12 +1259,15 @@ def _run_fleet(argv: list[str]) -> int:
     """The ``fleet`` subcommand: an N-replica Poisson drill through the
     replicated serving layer (``fleet.FleetRouter``) — shape-affinity
     routing, lease-checked replicas, optional mid-stream replica kill
-    with journal-backed handoff, SIGTERM-graceful drain."""
+    with journal-backed handoff, optional REJOIN of the killed replica
+    as a fresh incarnation, a pluggable (memory/file) lease store,
+    SIGTERM-graceful drain."""
+    import os as _os
     import random
     import tempfile
     import time as _time
 
-    from poisson_ellipse_tpu.fleet import FleetRouter
+    from poisson_ellipse_tpu.fleet import FileLeaseStore, FleetRouter
     from poisson_ellipse_tpu.resilience import faultinject
     from poisson_ellipse_tpu.resilience.errors import FleetUnavailableError
     from poisson_ellipse_tpu.serve import EXIT_BY_OUTCOME
@@ -1288,6 +1291,20 @@ def _run_fleet(argv: list[str]) -> int:
         "--kill-replica-at", type=int, default=None, metavar="INDEX",
         help="SIGKILL replica 0 when arrival INDEX lands (journal "
         "handoff drill); default: no kill",
+    )
+    ap.add_argument(
+        "--rejoin-at", type=int, default=None, metavar="INDEX",
+        help="re-enter the killed replica 0 as a FRESH incarnation "
+        "when arrival INDEX lands (fresh epoch, archived-journal "
+        "replay, warm-pool pre-warm); needs --kill-replica-at earlier "
+        "in the stream",
+    )
+    ap.add_argument(
+        "--lease-store", choices=("memory", "file"), default="memory",
+        help="the fleet's lease/fencing store: 'memory' is the "
+        "in-process default; 'file' persists epochs to "
+        "<journal-dir>/lease-store.json (atomic rename, fsync) so a "
+        "restarted driver fences against the previous run's epochs",
     )
     ap.add_argument("--grids", default="10x10,12x12")
     ap.add_argument("--rate", type=float, default=200.0)
@@ -1328,6 +1345,17 @@ def _run_fleet(argv: list[str]) -> int:
                 raise ValueError("--requests must be >= 1")
             if args.rate <= 0:
                 raise ValueError("--rate must be > 0 requests/second")
+            if args.rejoin_at is not None:
+                if args.kill_replica_at is None:
+                    raise ValueError(
+                        "--rejoin-at needs --kill-replica-at: only a "
+                        "dead replica can rejoin"
+                    )
+                if args.rejoin_at <= args.kill_replica_at:
+                    raise ValueError(
+                        "--rejoin-at must land strictly after "
+                        "--kill-replica-at"
+                    )
             journal_dir = args.journal_dir
             if journal_dir is None:
                 tmp_dir = tempfile.TemporaryDirectory()
@@ -1337,10 +1365,16 @@ def _run_fleet(argv: list[str]) -> int:
                 faults.append(faultinject.replica_kill(
                     at_request=args.kill_replica_at, replica=0,
                 ))
+            lease_store = None
+            if args.lease_store == "file":
+                lease_store = FileLeaseStore(
+                    _os.path.join(journal_dir, "lease-store.json"),
+                )
             router = FleetRouter(
                 replicas=args.replicas,
                 journal_dir=journal_dir,
                 lease_s=args.lease,
+                lease_store=lease_store,
                 faults=faultinject.FaultPlan(*faults),
                 lanes=args.lanes,
                 chunk=args.chunk,
@@ -1357,7 +1391,7 @@ def _run_fleet(argv: list[str]) -> int:
         drained_early = False
         try:
             with _SigtermDrain() as term:
-                for _ in range(args.requests):
+                for i in range(args.requests):
                     if term.requested:
                         drained_early = True
                         obs_trace.event("serve:sigterm-drain")
@@ -1369,6 +1403,12 @@ def _run_fleet(argv: list[str]) -> int:
                     )
                     _time.sleep(min(rng.expovariate(args.rate), 0.05))
                     router.step()
+                    if (args.rejoin_at is not None
+                            and i >= args.rejoin_at
+                            and not router.rejoins):
+                        victim = router._by_id(0)
+                        if victim is not None and not victim.live:
+                            router.rejoin_replica(0)
                     results.update(router.collect())
                 else:
                     results.update(router.drain())
@@ -1395,6 +1435,11 @@ def _run_fleet(argv: list[str]) -> int:
             "handoffs": router.handoffs,
             "adopted": router.adopted_total,
             "handoff_p99_s": handoff.quantile(0.99),
+            "rejoins": router.rejoins,
+            "rejoin_p99_s": obs_metrics.REGISTRY.histogram(
+                obs_metrics.REJOIN_LATENCY_SECONDS
+            ).quantile(0.99),
+            "lease_store": args.lease_store,
             "live_replicas": [r.replica_id for r in router.live_replicas()],
             "wall_s": round(wall, 4),
             "drained_on_sigterm": drained_early,

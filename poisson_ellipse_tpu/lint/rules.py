@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU020.
+"""The tpulint rule registry: TPU001–TPU021.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -95,6 +95,13 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | sweep that layer, so a stray collective       |
 |        |                    | drifts the count invisibly; deliberate        |
 |        |                    | exceptions carry a justified disable          |
+| TPU021 | wall-clock-lease   | wall-clock reads (`time.time()`,              |
+|        |                    | `datetime.now()`) used in lease/deadline      |
+|        |                    | ARITHMETIC (`t0 + lease_s`, `now - started`) —|
+|        |                    | TPU016's comparison prong extended: a duration|
+|        |                    | or deadline COMPUTED from the wall clock is   |
+|        |                    | stepped by NTP before any comparison happens; |
+|        |                    | bare record-only timestamps stay silent       |
 """
 
 from __future__ import annotations
@@ -202,6 +209,15 @@ class LintConfig:
     # outside it is invisible to those budgets until it breaks one.
     collective_modules: tuple[str, ...] = (
         "*/parallel/*", "parallel/*",
+    )
+    # TPU021: the wall-clock sources whose results must not feed
+    # lease/deadline/duration arithmetic (resolved-qualname fnmatch
+    # patterns — a project wrapping another stepping clock, e.g.
+    # `arrow.utcnow`, extends the set here). time.monotonic() and
+    # perf_counter() are immune by construction and never listed.
+    wall_clock_fns: tuple[str, ...] = (
+        "time.time", "datetime.datetime.now", "datetime.datetime.utcnow",
+        "datetime.now", "datetime.utcnow",
     )
 
 
@@ -2790,3 +2806,177 @@ def check_raw_collective(module: Module,
                 "`collective-modules`; route the exchange through "
                 "parallel/ or annotate the deliberate exception",
             )
+
+
+# --------------------------------------------------------------------------
+# TPU021 — wall-clock reads feeding lease/deadline/duration ARITHMETIC
+# --------------------------------------------------------------------------
+
+# the arithmetic operators that turn a clock read into a deadline or a
+# duration (unary ops and bit ops read as something else entirely)
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod)
+
+
+def _config_wall_clock_calls(module: Module, root: ast.AST,
+                             config: LintConfig) -> list[ast.Call]:
+    """Every call of a configured wall-clock source (`wall-clock-fns`)
+    in ``root``'s subtree."""
+    out = []
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        q = module.qualname(node.func) or ""
+        if any(fnmatch.fnmatch(q, pat) for pat in config.wall_clock_fns):
+            out.append(node)
+    return out
+
+
+def _arith_ancestor(module: Module, node: ast.AST) -> Optional[ast.BinOp]:
+    for anc in module.ancestors(node):
+        if isinstance(anc, ast.BinOp) and isinstance(anc.op, _ARITH_OPS):
+            return anc
+    return None
+
+
+def _inside_ordering_compare(module: Module, node: ast.AST) -> bool:
+    return any(
+        isinstance(anc, ast.Compare) and _is_ordering_compare(anc)
+        for anc in module.ancestors(node)
+    )
+
+
+def _name_in_arith(scope_root: ast.AST, name: str,
+                   exclude: set = frozenset()) -> bool:
+    """Is ``name`` read as an operand of arithmetic within
+    ``scope_root`` (same shadowing discipline as TPU016's
+    :func:`_name_compared_in`)?"""
+    for node in _walk_excluding(scope_root, exclude):
+        if not isinstance(node, ast.BinOp) or not isinstance(
+            node.op, _ARITH_OPS
+        ):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _self_attr_in_arith(scope_root: ast.AST, attr: str) -> bool:
+    for node in ast.walk(scope_root):
+        if not isinstance(node, ast.BinOp) or not isinstance(
+            node.op, _ARITH_OPS
+        ):
+            continue
+        for sub in ast.walk(node):
+            if _attr_is_self(sub, attr):
+                return True
+    return False
+
+
+@rule(
+    "TPU021",
+    "wall-clock-lease",
+    "a wall-clock read (time.time()/datetime.now()) feeding lease/"
+    "deadline/duration ARITHMETIC — NTP steps the clock mid-computation; "
+    "compute spans and deadlines from time.monotonic()",
+)
+def check_wall_clock_lease(module: Module,
+                           config: LintConfig) -> Iterator[Finding]:
+    """TPU016's arithmetic sibling. TPU016 fires when a wall-clock read
+    reaches a COMPARISON (the deadline check itself); this rule fires
+    one step earlier, when the read feeds lease/deadline/duration
+    ARITHMETIC — ``deadline = time.time() + lease_s``,
+    ``elapsed = datetime.now() - started`` — whether or not the result
+    is ever compared in this module. The computed value is already
+    wrong the instant NTP steps the clock: handed to a peer process, a
+    trace record used for pacing, or a retry budget, it fires early or
+    never with no comparison in sight for TPU016 to catch. The scopes
+    are disjoint by construction: a read inside an ordering comparison
+    is TPU016's finding and skipped here.
+
+    Two prongs, mirroring TPU016's, same conservative stance — a bare
+    recorded timestamp (``"t_admit_unix": time.time()``, a trace
+    record's ``unix_time``) touches no arithmetic and stays silent:
+
+    - **arithmetic directly** — a configured wall-clock call
+      (`wall-clock-fns`: ``time.time``, ``datetime.now``/``utcnow`` by
+      default) that is an operand of ``+ - * / // %``.
+    - **bound then arithmetic** — a name (or ``self`` attribute)
+      assigned from a wall-clock read, later used as an arithmetic
+      operand visible to that binding (the TPU012 shadowing
+      discipline, reused via TPU016's machinery).
+    """
+    emitted: set[tuple[int, int]] = set()
+
+    def once(finding):
+        key = (finding.line, finding.col)
+        if key not in emitted:
+            emitted.add(key)
+            yield finding
+
+    # prong 1: the wall-clock call itself is an arithmetic operand —
+    # unless the whole expression sits inside an ordering comparison,
+    # which is TPU016's finding (the scopes stay disjoint)
+    for call in _config_wall_clock_calls(module, module.tree, config):
+        if _arith_ancestor(module, call) is None:
+            continue
+        if _inside_ordering_compare(module, call):
+            continue
+        q = module.qualname(call.func)
+        yield from once(_finding(
+            module,
+            call,
+            "TPU021",
+            f"`{q}()` feeds lease/deadline/duration arithmetic: an NTP "
+            "step lands inside the computed value — compute spans and "
+            "deadlines from `time.monotonic()` and keep wall-clock "
+            "reads for record-only timestamps",
+        ))
+
+    # prong 2: NAME/self.ATTR = <wall-clock read>, with the binding
+    # later an arithmetic operand in a scope the binding is visible to
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        value = node.value
+        if value is None:
+            continue
+        calls = _config_wall_clock_calls(module, value, config)
+        if not calls:
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        flat: list[ast.AST] = []
+        for target in targets:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                flat.extend(target.elts)
+            else:
+                flat.append(target)
+        enclosing = module.enclosing_function(node)
+        hot = False
+        for t in flat:
+            if isinstance(t, ast.Name):
+                scope = enclosing if enclosing is not None else module.tree
+                if _name_in_arith(
+                    scope, t.id, _shadowing_functions(scope, t.id)
+                ):
+                    hot = True
+            elif _attr_is_self(t, getattr(t, "attr", "")):
+                cls = _enclosing_class(module, node)
+                if _self_attr_in_arith(
+                    cls if cls is not None else module.tree, t.attr
+                ):
+                    hot = True
+        if not hot:
+            continue
+        for call in calls:
+            yield from once(_finding(
+                module,
+                call,
+                "TPU021",
+                "wall-clock read bound to a name later used in "
+                "arithmetic: the computed lease/deadline/duration is "
+                "stepped by NTP before anything compares it — bind "
+                "`time.monotonic()` for anything that feeds arithmetic",
+            ))

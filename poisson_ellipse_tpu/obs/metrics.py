@@ -242,12 +242,22 @@ def histogram(name: str) -> Histogram:
 #   fleet_handoff_requests_total counter    requests re-admitted by handoff
 #   fleet_stale_writes_total     counter    fenced zombie writes rejected
 #   handoff_latency_seconds      histogram  per-handoff journal→survivor time
+#   fleet_rejoin_total           counter    dead replicas re-issued as fresh
+#                                           incarnations (fleet survivability)
+#   rejoin_latency_seconds       histogram  kill → first completed solve
+#                                           delivered by the rejoined replica
+#   fleet_starvation_total       counter    tenant-class starvation episodes
+#                                           announced (serve.queue — loud,
+#                                           never silent)
 
 LEASE_EXPIRY_TOTAL = "lease_expiry_total"
 FLEET_HANDOFF_TOTAL = "fleet_handoff_total"
 FLEET_HANDOFF_REQUESTS_TOTAL = "fleet_handoff_requests_total"
 FLEET_STALE_WRITES_TOTAL = "fleet_stale_writes_total"
 HANDOFF_LATENCY_SECONDS = "handoff_latency_seconds"
+FLEET_REJOIN_TOTAL = "fleet_rejoin_total"
+REJOIN_LATENCY_SECONDS = "rejoin_latency_seconds"
+FLEET_STARVATION_TOTAL = "fleet_starvation_total"
 
 
 def replica_gauge(name: str, replica: int) -> Gauge:

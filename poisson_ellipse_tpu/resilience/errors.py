@@ -211,6 +211,39 @@ class FleetUnavailableError(SolveError):
         self.retry_after_s = retry_after_s
 
 
+class LeaseStoreError(RuntimeError):
+    """Base of the lease-store (``fleet.replica.LeaseStore``) failure
+    taxonomy. These are *infrastructure* errors, not solve errors: they
+    never escape the fleet router to a caller. The router converts
+    "store unreachable past the grace window" into a classified
+    :class:`FleetUnavailableError` (exit 9) at the admission boundary —
+    fail-safe, never a hang — and everything else into deferred work
+    that completes when the store recovers. ``classification`` is the
+    tag used in trace events."""
+
+    classification = "lease-store"
+
+
+class LeaseStoreOutageError(LeaseStoreError):
+    """The lease store is unreachable (injected partition/outage, or a
+    real backend refusing the round-trip). Replicas holding unexpired
+    leases keep serving — epoch *validation* answers from the local
+    cache mirror — but every operation that must round-trip (issuing a
+    fresh incarnation, fencing a dead one) raises this until the store
+    answers a ping again."""
+
+    classification = "lease-store-outage"
+
+
+class LeaseStoreCorruptError(LeaseStoreError):
+    """The persisted lease-store state failed to parse (torn write,
+    truncation, bit rot). Classified loudly instead of re-initialising
+    the epoch table: silently resetting epochs would let a fenced
+    zombie's stale token validate again — the textbook split-brain."""
+
+    classification = "lease-store-corrupt"
+
+
 # status phrasings XLA/Mosaic use for memory exhaustion, across runtime
 # versions; matched case-sensitively (they are absl status spellings)
 _OOM_MARKERS = (
@@ -260,6 +293,8 @@ def classify_error(exc: BaseException) -> str:
     SolveErrors keep their own tag) or ``unknown`` for everything else —
     unknowns must stay loud, never be swallowed into a retry loop."""
     if isinstance(exc, SolveError):
+        return exc.classification
+    if isinstance(exc, LeaseStoreError):
         return exc.classification
     if is_oom_error(exc):
         return "oom"

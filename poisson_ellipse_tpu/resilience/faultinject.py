@@ -57,6 +57,15 @@ guard):
                    drill), or skew its lease clock (the NTP-step
                    drill). All seed-deterministic and addressable from
                    chaos plans like every other kind.
+- ``lease_store_outage`` / ``lease_store_latency`` — the COORDINATION
+                   SERVICE faults (``fleet.replica.LeaseStore``):
+                   partition the lease store out from under a live
+                   fleet for ``delay_s`` seconds, or make every store
+                   round-trip stall. The fleet must degrade fail-safe
+                   (serve on unexpired leases, defer membership
+                   changes, refuse new admissions past the grace
+                   window with classified backpressure) and never
+                   split-brain.
 
 Separately, :func:`simulated_vmem` shrinks the VMEM capacity the engine
 capacity gates (``fits_resident``/``fits_streamed``) read — so
@@ -88,6 +97,7 @@ FAULT_KINDS = (
     "halo_bitflip", "psum_corrupt", "device_loss", "straggler",
     "malformed_spec", "degenerate_geometry",
     "replica_kill", "replica_hang", "lease_clock_skew",
+    "lease_store_outage", "lease_store_latency",
 )
 
 # dispatch-level faults: consulted by the driver holding the dispatch
@@ -104,6 +114,15 @@ ADMISSION_KINDS = ("malformed_spec", "degenerate_geometry")
 # or clock-skew a WHOLE scheduler replica, so the lease/fencing/handoff
 # machinery is what gets exercised
 REPLICA_KINDS = ("replica_kill", "replica_hang", "lease_clock_skew")
+
+# lease-store faults: consulted by the fleet router at arrival
+# boundaries like REPLICA_KINDS, but the target is the COORDINATION
+# SERVICE itself (fleet.replica.LeaseStore), not any one replica —
+# they partition or slow the store, so the outage grace window /
+# deferred-death / recovery-revalidation machinery is what gets
+# exercised. ``delay_s`` carries the outage duration (outage) or the
+# per-round-trip stall (latency).
+LEASE_STORE_KINDS = ("lease_store_outage", "lease_store_latency")
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -214,6 +233,14 @@ class Fault:
                 raise ValueError("at_request must be >= 0")
             if self.kind == "replica_hang" and self.delay_s < 0:
                 raise ValueError("delay_s must be >= 0")
+        if self.kind in LEASE_STORE_KINDS:
+            if self.at_request < 0:
+                raise ValueError("at_request must be >= 0")
+            if self.delay_s <= 0:
+                raise ValueError(
+                    "lease-store faults need delay_s > 0 (the outage "
+                    "duration or the per-round-trip stall)"
+                )
 
 
 def inject_nan(at_iter: int, field: str = "r",
@@ -328,6 +355,31 @@ def lease_clock_skew(skew_s: float, at_request: int = 0,
     rather than let two replicas both believe they own the requests."""
     return Fault("lease_clock_skew", at_request=at_request,
                  replica=replica, skew_s=skew_s)
+
+
+def lease_store_outage(duration_s: float, at_request: int = 0) -> Fault:
+    """Partition the lease store out from under the fleet for
+    ``duration_s`` seconds from arrival ``at_request``: every store
+    round-trip (issue / fence / ping / refresh) raises
+    ``LeaseStoreOutageError`` until the duration passes. Replicas
+    holding unexpired leases keep serving (epoch VALIDATION answers
+    from the local cache — fail-safe, not fail-open), deaths detected
+    during the outage are deferred until the store answers again, and
+    admissions past the router's grace window are refused with
+    classified, capped-exponential backpressure — never a hang, never
+    split-brain ownership."""
+    return Fault("lease_store_outage", at_request=at_request,
+                 delay_s=duration_s)
+
+
+def lease_store_latency(delay_s: float, at_request: int = 0) -> Fault:
+    """The slow-quorum drill: from arrival ``at_request`` every lease
+    store round-trip stalls ``delay_s`` first (sticky, not one-shot in
+    effect — the latency stays armed once applied). Membership changes
+    get slower; the steady-state write path (fenced journal writes,
+    epoch validation) must NOT, because validation never round-trips."""
+    return Fault("lease_store_latency", at_request=at_request,
+                 delay_s=delay_s)
 
 
 MALFORMED_SPEC = {"kind": "dodecahedron", "r": -1.0}

@@ -65,11 +65,26 @@ class ChaosReport:
     # completion missing its payload is a classification failure
     grad_requests: int = 0
     grad_missing_payload: list = dataclasses.field(default_factory=list)
+    # the survivability drills' evidence: rejoins executed, redirect
+    # sheds issued by draining schedulers (unrecorded by design —
+    # counted so zero-lost stays provable across a kill-mid-drain),
+    # ids co-owned by two live replicas at ANY boundary (must stay
+    # empty: the cross-epoch co-ownership violation), starvation
+    # episodes observed and the tenants whose episodes outnumbered
+    # their announcements (starved SILENTLY — must stay empty), and
+    # per-tenant outcome counts for the mixed-tenant stream
+    rejoins: int = 0
+    drain_shed: int = 0
+    co_owned: list = dataclasses.field(default_factory=list)
+    starvation_events: int = 0
+    starved_silent: list = dataclasses.field(default_factory=list)
+    tenants: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
         return not (self.lost or self.double_completed or self.unclassified
-                    or self.grad_missing_payload)
+                    or self.grad_missing_payload or self.co_owned
+                    or self.starved_silent)
 
     def json_dict(self) -> dict:
         out = dataclasses.asdict(self)
@@ -104,6 +119,13 @@ def run_chaos(
     kill_during_handoff: bool = False,
     zombie: bool = False,
     lease_s: float = 0.25,
+    replica_rejoin: Optional[int] = None,
+    replica_kill_again: Optional[int] = None,
+    lease_store_outage: Optional[int] = None,
+    lease_store_outage_s: float = 0.05,
+    tenant_mix: Optional[Sequence[tuple[str, int]]] = None,
+    class_quotas: Optional[dict] = None,
+    starvation_after_s: Optional[float] = None,
 ) -> ChaosReport:
     """Drive one seeded chaos stream; see the module docstring.
 
@@ -157,6 +179,24 @@ def run_chaos(
     by its fenced journal — the observed-and-rejected stale write is
     part of the report). The per-request NaN/OOM faults keep firing on
     whichever replica hosts their victims — one plan, fleet-wide.
+
+    The SURVIVABILITY drills (all fleet-only, all opt-in — the default
+    drill set is unchanged): ``replica_rejoin`` names the arrival index
+    at which the killed/fenced replica 0 re-enters as a fresh
+    incarnation (``FleetRouter.rejoin_replica`` — archived-journal
+    replay, warm-pool pre-warm, new epoch); ``replica_kill_again``
+    kills the REJOINED incarnation at a later index, proving the
+    kill→rejoin→kill-again ladder keeps zero-lost/zero-double;
+    ``lease_store_outage`` arms a coordination-service outage of
+    ``lease_store_outage_s`` seconds starting at that arrival index
+    (deaths inside the window defer their fence+handoff; admissions
+    past the grace window shed classified ``fleet-unavailable``);
+    ``tenant_mix`` is a sequence of ``(tenant, priority)`` classes the
+    seeded stream draws from (with optional ``class_quotas`` /
+    ``starvation_after_s`` passed to every replica's queue) — the
+    report adds per-tenant outcome counts and pins that no tenant
+    starved silently. At EVERY boundary the router's co-ownership
+    audit runs; any id live-owned by two replicas fails the report.
     """
     if n_requests < 1:
         raise ValueError("need at least one request")
@@ -190,7 +230,27 @@ def run_chaos(
             deadline_s=deadline_s, max_retries=max_retries,
             replicas=replicas, replica_kill=replica_kill,
             kill_during_handoff=kill_during_handoff, zombie=zombie,
-            lease_s=lease_s,
+            lease_s=lease_s, replica_rejoin=replica_rejoin,
+            replica_kill_again=replica_kill_again,
+            lease_store_outage=lease_store_outage,
+            lease_store_outage_s=lease_store_outage_s,
+            tenant_mix=tenant_mix, class_quotas=class_quotas,
+            starvation_after_s=starvation_after_s,
+        )
+    fleet_only = {
+        "replica_rejoin": replica_rejoin,
+        "replica_kill_again": replica_kill_again,
+        "lease_store_outage": lease_store_outage,
+        "tenant_mix": tenant_mix,
+        "class_quotas": class_quotas,
+        "starvation_after_s": starvation_after_s,
+    }
+    armed_fleet = [k for k, v in fleet_only.items() if v is not None]
+    if armed_fleet:
+        raise ValueError(
+            f"{', '.join(armed_fleet)} are fleet drills the "
+            "single-scheduler path (replicas == 1) does not run — "
+            "use replicas > 1"
         )
     if kill_after is None:
         kill_after = n_requests // 2
@@ -323,6 +383,7 @@ def run_chaos(
         ),
         grad_requests=sum(1 for i in grad_requests if i < n_requests),
         grad_missing_payload=grad_missing,
+        drain_shed=sched.drain_sheds,
     )
     obs_trace.event("serve:chaos-report", **report.json_dict())
     return report
@@ -346,6 +407,13 @@ def _run_fleet_chaos(
     kill_during_handoff: bool,
     zombie: bool,
     lease_s: float,
+    replica_rejoin: Optional[int],
+    replica_kill_again: Optional[int],
+    lease_store_outage: Optional[int],
+    lease_store_outage_s: float,
+    tenant_mix,
+    class_quotas: Optional[dict],
+    starvation_after_s: Optional[float],
 ) -> ChaosReport:
     """The fleet half of :func:`run_chaos` (see its docstring).
 
@@ -380,6 +448,33 @@ def _run_fleet_chaos(
         )
     if replica_kill is None and not zombie:
         replica_kill = n_requests // 2
+    victim_boundary = replica_kill if replica_kill is not None else (
+        max(n_requests // 3, 1) if zombie else None
+    )
+    if replica_rejoin is not None:
+        if victim_boundary is None:
+            raise ValueError(
+                "replica_rejoin needs a victim: arm replica_kill or "
+                "zombie so there is a dead incarnation to rejoin"
+            )
+        if not victim_boundary < replica_rejoin < n_requests:
+            raise ValueError(
+                f"replica_rejoin={replica_rejoin} must land strictly "
+                f"after the victim boundary ({victim_boundary}) and "
+                f"before the stream ends ({n_requests})"
+            )
+    if replica_kill_again is not None:
+        if replica_rejoin is None:
+            raise ValueError(
+                "replica_kill_again kills the REJOINED incarnation: it "
+                "needs replica_rejoin"
+            )
+        if not replica_rejoin < replica_kill_again < n_requests:
+            raise ValueError(
+                f"replica_kill_again={replica_kill_again} must land "
+                f"strictly after replica_rejoin ({replica_rejoin}) and "
+                f"before the stream ends ({n_requests})"
+            )
     rng = random.Random(seed)
     faults = []
     if nan_request is not None and nan_request < n_requests:
@@ -400,6 +495,11 @@ def _run_fleet_chaos(
         faults.append(faultinject.replica_hang(
             delay_s=float("inf"), at_request=hang_at, replica=0,
         ))
+    if lease_store_outage is not None and \
+            0 < lease_store_outage < n_requests:
+        faults.append(faultinject.lease_store_outage(
+            lease_store_outage_s, at_request=lease_store_outage,
+        ))
     plan = FaultPlan(*faults)
 
     t0 = time.monotonic()
@@ -414,6 +514,8 @@ def _run_fleet_chaos(
         max_retries=max_retries,
         backoff_base_s=0.001,
         keep_solutions=False,
+        class_quotas=class_quotas,
+        starvation_after_s=starvation_after_s,
         # the per-replica schedulers share the ONE plan, so the
         # request-addressed faults fire on whichever replica hosts
         # their victim — and fire once, fleet-wide
@@ -429,12 +531,21 @@ def _run_fleet_chaos(
 
     stale_rejected = 0
     second_killed = False
+    killed_again = False
+    rejoin_due = replica_rejoin
+    tenant_of: dict[str, str] = {}
+    co_owned: set[str] = set()
     for i in range(n_requests):
         time.sleep(min(rng.expovariate(rate_per_s), 0.01))
         M, N = rng.choice(list(grids))
+        tenant, priority = (
+            ("default", 1) if tenant_mix is None
+            else rng.choice(list(tenant_mix))
+        )
+        tenant_of[_chaos_id(i)] = tenant
         req_kw = dict(
             deadline_s=deadline_s, max_retries=max_retries,
-            request_id=_chaos_id(i),
+            request_id=_chaos_id(i), tenant=tenant, priority=priority,
         )
         try:
             router.submit(Problem(M=M, N=N), **req_kw)
@@ -468,6 +579,28 @@ def _run_fleet_chaos(
                 hung.lease.deadline = router.clock() - 1.0
         router.step()
         harvest()
+        if rejoin_due is not None and i >= rejoin_due:
+            victim = router._by_id(0)
+            if victim is not None and not victim.live:
+                try:
+                    router.rejoin_replica(0)
+                    rejoin_due = None
+                except FleetUnavailableError:
+                    # a lease-store outage refuses the rejoin (minting
+                    # an incarnation needs the store): retry at the
+                    # next boundary — recovery re-arms it
+                    pass
+        if (replica_kill_again is not None and i >= replica_kill_again
+                and rejoin_due is None and not killed_again):
+            # the second kill hits the REJOINED incarnation: the ladder
+            # under test is kill → rejoin → kill-again, with zero
+            # lost/double across BOTH epochs of replica 0
+            killed_again = True
+            router.kill_replica(0)
+        # the cross-epoch co-ownership audit, every boundary: any id
+        # live-owned twice at ANY instant is evidence, even if a later
+        # completion would hide it from an end-of-run check
+        co_owned.update(router.audit_ownership())
     # zombie resurrection: the hang clears, the dead-but-alive replica
     # runs its own serve loop again — every completion it attempts must
     # be rejected by its fenced journal, never delivered
@@ -481,6 +614,16 @@ def _run_fleet_chaos(
             except StaleLeaseError:
                 stale_rejected += 1
                 break
+    if rejoin_due is not None:
+        # the stream ended with the rejoin still owed (a long outage):
+        # one last attempt after a store probe, so the drill is judged
+        # on the recovered fleet rather than a mid-outage snapshot
+        victim = router._by_id(0)
+        if victim is not None and not victim.live:
+            try:
+                router.rejoin_replica(0)
+            except FleetUnavailableError:
+                pass
     try:
         router.drain()
     except FleetUnavailableError:
@@ -489,6 +632,7 @@ def _run_fleet_chaos(
         # up in `lost`, which is exactly what that scenario IS
         pass
     harvest()
+    co_owned.update(router.audit_ownership())
 
     submitted = [_chaos_id(i) for i in range(n_requests)]
     outcomes = {
@@ -502,6 +646,15 @@ def _run_fleet_chaos(
     counts: dict[str, int] = {}
     for out in outcomes.values():
         counts[out] = counts.get(out, 0) + 1
+    episodes, announced = router.starvation_counts()
+    starved_silent = sorted(
+        t for t, n in episodes.items() if n > announced.get(t, 0)
+    )
+    tenants: dict[str, dict] = {}
+    if tenant_mix is not None:
+        for rid, out in outcomes.items():
+            per = tenants.setdefault(tenant_of.get(rid, "default"), {})
+            per[out] = per.get(out, 0) + 1
     report = ChaosReport(
         n_requests=n_requests,
         outcomes=outcomes,
@@ -512,7 +665,7 @@ def _run_fleet_chaos(
         replayed=router.adopted_total,
         killed=any(
             f.kind == "replica_kill" and f.fired for f in faults
-        ) or second_killed,
+        ) or second_killed or killed_again,
         faults_fired=sum(1 for f in faults if f.fired),
         wall_s=time.monotonic() - t0,
         replicas=replicas,
@@ -520,6 +673,12 @@ def _run_fleet_chaos(
         adopted=router.adopted_total,
         stale_writes_rejected=stale_rejected,
         zombie_drill=zombie,
+        rejoins=router.rejoins,
+        drain_shed=router.drain_shed_total(),
+        co_owned=sorted(co_owned),
+        starvation_events=sum(episodes.values()),
+        starved_silent=starved_silent,
+        tenants=tenants,
     )
     obs_trace.event("serve:fleet-chaos-report", **report.json_dict())
     return report

@@ -155,6 +155,14 @@ class RequestJournal:
             if rec["state"] == "admitted"
         ]
 
+    def admitted_ids(self) -> set[str]:
+        """Ids with a LIVE admitted record (finished/compacted ones
+        excluded) — the fleet's co-ownership audit reads this."""
+        return {
+            rid for rid, rec in self._records.items()
+            if rec["state"] == "admitted"
+        }
+
     def state_of(self, request_id: str) -> dict | None:
         """The live record, a compacted ``{"state": "done"}`` stub for a
         request this journal instance saw finish, or None."""

@@ -18,6 +18,29 @@ rejections the scheduler classifies under another outcome (replay
 ``deadline-miss``, retry-overflow ``failed``) stay silent here so the
 counter always equals the number of shed outcomes. Depth is published
 as the ``queue_depth`` gauge on every transition.
+
+Multi-tenant admission classes ride on the same bound: every request
+carries ``tenant``/``priority`` (``serve.request``), and three policies
+apply when they differ —
+
+- **per-class quotas** (``class_quotas={tenant: max_queued}``): a
+  tenant at its quota sheds with reason ``tenant-quota`` even while the
+  queue has room, so one chatty tenant cannot monopolise the bound;
+- **queue-full preemption**: a full queue admits a HIGHER-priority
+  arrival by evicting the lowest-priority (most recently enqueued)
+  queued request instead of shedding the arrival — low-priority work
+  sheds first under pressure, never the other way around. Victims land
+  in ``take_evicted()`` for the scheduler to classify (terminal
+  ``shed`` with detail ``preempted-by-priority``), never dropped;
+- **priority-first dispatch**: ``pop_ready`` serves the highest
+  priority among ready requests, FIFO within a class.
+
+Priority scheduling can starve: a class that stays ready-but-unserved
+past ``starvation_after_s`` is a LOUD ``fleet:starvation`` event (and
+``fleet_starvation_total`` count) once per episode — never silent. The
+queue tracks detection (``starvation_episodes``) and announcement
+(``starvation_announced``) separately so the chaos report can prove no
+episode went unannounced.
 """
 
 from __future__ import annotations
@@ -46,7 +69,9 @@ class AdmissionQueue:
     """
 
     def __init__(self, capacity: int, lanes: int,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 class_quotas: Optional[dict] = None,
+                 starvation_after_s: Optional[float] = None):
         if capacity < 1:
             raise ValueError("queue capacity must be >= 1")
         if lanes < 1:
@@ -59,6 +84,16 @@ class AdmissionQueue:
         # silent-drop-on-full behaviour is unreachable
         self._q: collections.deque = collections.deque(maxlen=capacity)
         self._service_ewma = _INITIAL_SERVICE_S
+        # multi-tenant policy state (module docstring): quotas, the
+        # preemption victim hand-off, and per-episode starvation
+        # bookkeeping (detection and announcement counted separately so
+        # "never silent" is checkable, not asserted)
+        self.class_quotas = dict(class_quotas) if class_quotas else None
+        self.starvation_after_s = starvation_after_s
+        self._evicted: list[ServeRequest] = []
+        self.starvation_episodes: dict[str, int] = {}
+        self.starvation_announced: dict[str, int] = {}
+        self._starving: set[str] = set()
 
     def __len__(self) -> int:
         return len(self._q)
@@ -100,11 +135,22 @@ class AdmissionQueue:
         requests whose *outcome* is shed.
         """
         now = self.clock()
+        if self.class_quotas is not None:
+            quota = self.class_quotas.get(request.tenant)
+            queued = sum(
+                1 for r in self._q if r.tenant == request.tenant
+            )
+            if quota is not None and queued >= quota:
+                retry_after = self.projected_wait()
+                if record_shed:
+                    self._shed(request, "tenant-quota", retry_after)
+                return False, retry_after, "tenant-quota"
         if len(self._q) >= self.capacity:
-            retry_after = self.projected_wait()
-            if record_shed:
-                self._shed(request, "queue-full", retry_after)
-            return False, retry_after, "queue-full"
+            if not self._preempt_for(request):
+                retry_after = self.projected_wait()
+                if record_shed:
+                    self._shed(request, "queue-full", retry_after)
+                return False, retry_after, "queue-full"
         if request.deadline is not None:
             wait = self.projected_wait()
             if now + wait > request.deadline:
@@ -122,6 +168,47 @@ class AdmissionQueue:
             depth=len(self._q), grid=[request.problem.M, request.problem.N],
         )
         return True, None, None
+
+    def _preempt_for(self, request: ServeRequest) -> bool:
+        """Queue-full arbitration: evict the lowest-priority (most
+        recently enqueued among ties) queued request STRICTLY below the
+        arrival's priority, or report False (equal priority never
+        preempts — FIFO fairness within a class). The victim moves to
+        the ``take_evicted()`` hand-off for the scheduler to classify
+        terminally; it is never silently dropped."""
+        victim_i = None
+        victim = None
+        for i, req in enumerate(self._q):
+            if req.priority >= request.priority:
+                continue
+            if victim is None or req.priority < victim.priority or (
+                req.priority == victim.priority
+                and req.enqueued_t >= victim.enqueued_t
+            ):
+                victim_i, victim = i, req
+        if victim is None:
+            return False
+        del self._q[victim_i]
+        self._evicted.append(victim)
+        obs_metrics.counter("preempted_total").inc()
+        obs_metrics.gauge("queue_depth").set(len(self._q))
+        obs_trace.event(
+            "serve:preempt", request_id=victim.request_id,
+            tenant=victim.tenant, priority=victim.priority,
+            by=request.request_id, by_priority=request.priority,
+            depth=len(self._q),
+        )
+        # the victim's terminal outcome IS shed (the scheduler
+        # classifies it from take_evicted), so the shed counter/event
+        # fire here to keep shed_total == shed outcomes
+        self._shed(victim, "preempted-by-priority", self.projected_wait())
+        return True
+
+    def take_evicted(self) -> list[ServeRequest]:
+        """Drain the preemption victims accumulated since the last call
+        (the scheduler classifies each as a terminal ``shed``)."""
+        victims, self._evicted = self._evicted, []
+        return victims
 
     def retract(self, request: ServeRequest, reason: str) -> None:
         """Undo an admission that cannot be honoured after all (the
@@ -163,14 +250,67 @@ class AdmissionQueue:
     # -- dispatch side ------------------------------------------------------
 
     def pop_ready(self, now: float) -> Optional[ServeRequest]:
-        """The oldest request whose retry backoff has elapsed
-        (``not_before <= now``), removed; None when none is ready."""
+        """The highest-priority request whose retry backoff has elapsed
+        (``not_before <= now``), FIFO within a priority class, removed;
+        None when none is ready. Every pop also runs the starvation
+        scan: a class left ready-but-unserved past
+        ``starvation_after_s`` announces loudly (module docstring)."""
+        best_i = None
+        best = None
         for i, req in enumerate(self._q):
-            if req.not_before <= now:
-                del self._q[i]
-                obs_metrics.gauge("queue_depth").set(len(self._q))
-                return req
-        return None
+            if req.not_before <= now and (
+                best is None or req.priority > best.priority
+            ):
+                best_i, best = i, req
+        if best is None:
+            return None
+        del self._q[best_i]
+        obs_metrics.gauge("queue_depth").set(len(self._q))
+        self._scan_starvation(now, served=best.tenant)
+        return best
+
+    def _scan_starvation(self, now: float, served: str) -> None:
+        """Detect-and-announce, once per episode: any tenant with a
+        ready request older than ``starvation_after_s`` while ANOTHER
+        tenant gets served is starving. Detection
+        (``starvation_episodes``) and the ``fleet:starvation`` event /
+        counter (``starvation_announced``) are bumped in the same
+        breath — the chaos report cross-checks the two so a refactor
+        cannot keep detecting but stop announcing."""
+        if self.starvation_after_s is None:
+            return
+        oldest: dict[str, float] = {}
+        for req in self._q:
+            if req.not_before <= now and req.enqueued_t is not None:
+                wait = now - req.enqueued_t
+                if wait > oldest.get(req.tenant, -1.0):
+                    oldest[req.tenant] = wait
+        # a served or drained tenant's episode is over; it may starve
+        # (and announce) again later
+        self._starving &= set(oldest)
+        self._starving.discard(served)
+        for tenant, wait in sorted(oldest.items()):
+            if tenant == served or wait <= self.starvation_after_s:
+                continue
+            if tenant in self._starving:
+                continue
+            self._starving.add(tenant)
+            self.starvation_episodes[tenant] = (
+                self.starvation_episodes.get(tenant, 0) + 1
+            )
+            self.starvation_announced[tenant] = (
+                self.starvation_announced.get(tenant, 0) + 1
+            )
+            obs_metrics.counter("fleet_starvation_total").inc()
+            obs_trace.event(
+                "fleet:starvation", tenant=tenant,
+                waited_s=round(wait, 4), depth=len(self._q),
+            )
+
+    def request_ids(self) -> set[str]:
+        """Ids currently queued (the fleet's co-ownership audit reads
+        this alongside lanes, backlog and journal)."""
+        return {r.request_id for r in self._q}
 
     def expire(self, now: float) -> list[ServeRequest]:
         """Remove and return every queued request whose deadline has

@@ -99,6 +99,15 @@ class ServeRequest:
     # ``ServeResult.value``/``ServeResult.grad``
     grad: bool = False
     objective: Optional[dict] = None
+    # multi-tenant admission class (``serve.queue``): ``tenant`` names
+    # the accounting class (per-class queue quotas), ``priority`` its
+    # weight — HIGHER is more important. Under pressure low-priority
+    # work sheds first (queue-full preemption), ``pop_ready`` serves
+    # priority-first, and a dying replica's high-priority work is
+    # adopted first (``fleet.handoff``). Journal-round-tripped so a
+    # replayed request keeps its class.
+    tenant: str = "default"
+    priority: int = 1
     # scheduler bookkeeping (not part of the wire spec)
     enqueued_t: Optional[float] = None
     admitted_t: Optional[float] = None
@@ -149,6 +158,8 @@ class ServeRequest:
             "theta": self.theta,
             "grad": self.grad,
             "objective": self.objective,
+            "tenant": self.tenant,
+            "priority": self.priority,
         }
 
     @classmethod
@@ -170,6 +181,8 @@ class ServeRequest:
             theta=spec.get("theta"),
             grad=bool(spec.get("grad", False)),
             objective=spec.get("objective"),
+            tenant=spec.get("tenant", "default"),
+            priority=int(spec.get("priority", 1)),
         )
 
 

@@ -256,6 +256,8 @@ class Scheduler:
         faults: Optional[FaultPlan] = None,
         keep_solutions: bool = True,
         mesh=None,
+        class_quotas: Optional[dict] = None,
+        starvation_after_s: Optional[float] = None,
     ):
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
@@ -275,7 +277,11 @@ class Scheduler:
             RequestJournal(journal) if isinstance(journal, (str, bytes))
             or hasattr(journal, "__fspath__") else journal
         )
-        self.queue = AdmissionQueue(queue_capacity, lanes, clock=clock)
+        self.queue = AdmissionQueue(
+            queue_capacity, lanes, clock=clock,
+            class_quotas=class_quotas,
+            starvation_after_s=starvation_after_s,
+        )
         self.results: dict[str, ServeResult] = {}
         self._ctxs: dict[tuple, _BatchCtx] = {}
         # grad-kind lifecycle state (diff.serving.GradJob) keyed by
@@ -294,12 +300,19 @@ class Scheduler:
         # everything already admitted — the fleet's replica-drain hook
         # and the harness's SIGTERM path both flip it
         self.draining = False
+        # how many redirect sheds the draining latch issued: those
+        # results are deliberately NOT recorded (begin_drain docstring),
+        # so without this count a replica killed mid-drain would leave
+        # them invisible to the chaos report's zero-lost accounting
+        self.drain_sheds = 0
 
     # -- admission -----------------------------------------------------------
 
     def submit(self, problem: Problem, deadline_s: float | None = None,
                max_retries: int | None = None,
-               request_id: str | None = None) -> Optional[ServeResult]:
+               request_id: str | None = None,
+               tenant: str = "default",
+               priority: int = 1) -> Optional[ServeResult]:
         """Admit one request. Returns ``None`` on acceptance, or the
         terminal ``shed`` result (with ``retry_after_s``) when the
         admission policy rejects it."""
@@ -311,6 +324,8 @@ class Scheduler:
             max_retries=(
                 self.max_retries if max_retries is None else max_retries
             ),
+            tenant=tenant,
+            priority=priority,
         )
         if request_id is not None:
             req.request_id = request_id
@@ -436,6 +451,11 @@ class Scheduler:
 
     def submit_request(self, req: ServeRequest) -> Optional[ServeResult]:
         if self.draining:
+            # the redirect shed stays unrecorded (begin_drain), but it
+            # is COUNTED: drain_sheds is what keeps the chaos report's
+            # zero-lost accounting provable when this replica is killed
+            # mid-drain
+            self.drain_sheds += 1
             return ServeResult(
                 request_id=req.request_id, outcome="shed",
                 detail="draining",
@@ -460,6 +480,7 @@ class Scheduler:
             )
         self._apply_admission_faults(req)
         accepted, retry_after, reason = self.queue.admit(req)
+        self._classify_evicted()
         if not accepted:
             result = ServeResult(
                 request_id=req.request_id, outcome="shed", detail=reason,
@@ -522,6 +543,33 @@ class Scheduler:
             )
         )
 
+    def owned_live_ids(self) -> set[str]:
+        """Ids whose lifecycle is LIVE here — queued, backlogged, in a
+        lane, or journal-admitted (terminal/compacted records excluded).
+        The fleet router's cross-epoch co-ownership audit intersects
+        these sets across live replicas; any overlap is the split-brain
+        the fencing machinery exists to prevent."""
+        ids = set(self.queue.request_ids())
+        ids.update(r.request_id for r in self._replay_backlog)
+        ids.update(
+            slot.req.request_id
+            for ctx in self._ctxs.values()
+            for slot in ctx.slots
+            if slot is not None
+        )
+        if self.journal is not None:
+            ids.update(self.journal.admitted_ids())
+        return ids
+
+    def prewarm(self, problem: Problem) -> None:
+        """Build (or touch) the batch context for ``problem``'s compile
+        bucket WITHOUT admitting anything: the warm-pool pre-warming
+        hook a fleet rejoin uses to hand a fresh incarnation the
+        router's observed shape mix before it takes traffic, so its
+        first real requests land on warm contexts instead of paying
+        cold compiles on the serving path."""
+        self._ctx_for(ServeRequest(problem=problem))
+
     def replay(self) -> int:
         """Recover every journaled admitted-but-unfinished request (a
         restarted server's first act). Requests beyond the bounded
@@ -554,11 +602,27 @@ class Scheduler:
             accepted, retry_after, reason = self.queue.admit(
                 req, record_shed=False
             )
+            self._classify_evicted()
             if not accepted:
                 self._finish_queued(
                     req, "deadline-miss", detail=f"replay-{reason}",
                     retry_after=retry_after,
                 )
+
+    def _classify_evicted(self) -> None:
+        """Give every queue-preemption victim (``AdmissionQueue``'s
+        ``take_evicted``) its classified terminal: ``shed`` with detail
+        ``preempted-by-priority``. The victim WAS journaled at its own
+        admission, so the terminal is journaled too (the admit record
+        must not replay as a lost request after a crash) — which means
+        a preempted id cannot be resubmitted into the same process;
+        clients retry with a fresh id, exactly as for any journaled
+        terminal."""
+        for victim in self.queue.take_evicted():
+            self._finish_queued(
+                victim, "shed", detail="preempted-by-priority",
+                retry_after=self.queue.projected_wait(),
+            )
 
     # -- the serve loop ------------------------------------------------------
 

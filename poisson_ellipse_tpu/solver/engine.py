@@ -137,6 +137,10 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 #                                    no dynamic_update_slice
 #                 fcycle_budget    — whole-trace ppermute budget
 #                                    (halos_per_fcycle) applies
+#                 fleet_chaos      — the kill→rejoin fleet drill's
+#                                    survivability invariants hold and
+#                                    the chaos verdict is sensitive to
+#                                    each of them
 #               A row WITHOUT this key is itself a finding: registering
 #               an engine means declaring its structural contract.
 ENGINE_CAPS = {
@@ -153,7 +157,8 @@ ENGINE_CAPS = {
                 capacity=3, precond_kind=None, tunables={},
                 contracts=dict(sharded_psum=2, sharded_halo=1, abft=True,
                                guard="classical", storage_identity=True,
-                               storage_narrow=True, history_resident=True)),
+                               storage_narrow=True, history_resident=True,
+                               fleet_chaos=True)),
     "fused": dict(family="loop", storage=False, history=True,
                   capacity=None, precond_kind=None, tunables={},
                   contracts=dict(sharded_psum=2, sharded_halo=1,

@@ -174,9 +174,11 @@ def _fleet_key(**overrides):
         ],
         "non_decreasing": True,
         "handoff_p99_s": 0.002,
+        "rejoin_latency_s": 0.2,
         "kill_completed": 24,
         "handoffs": 1,
         "adopted": 3,
+        "rejoins": 1,
     }
     key.update(overrides)
     return key
@@ -208,6 +210,35 @@ def test_fleet_within_tolerance_is_clean():
     )
     assert regressions_between(old, new_round := make_round(fleet=new_key)) == []
     assert new_round["fleet"]["non_decreasing"]
+
+
+def test_fleet_rejoin_latency_growth_is_a_regression():
+    old = make_round(fleet=_fleet_key())
+    slow = 0.2 * (1 + TOL["rejoin-p99-pct"]) * 1.1
+    new = make_round(fleet=_fleet_key(rejoin_latency_s=slow))
+    assert ("fleet_rejoin_latency_s", "fleet") in regressions_between(
+        old, new
+    )
+
+
+def test_fleet_rejoin_absent_in_old_round_is_noted_not_failed():
+    # a pre-rejoin artifact has no rejoin_latency_s: the new round's
+    # number is noted one-sided, never failed against the absence
+    old = make_round(fleet=_fleet_key(rejoin_latency_s=None, rejoins=0))
+    new = make_round(fleet=_fleet_key())
+    regs, notes = bc.compare(old, new, TOL)
+    assert regs == []
+    assert any("rejoin_latency_s" in n for n in notes)
+
+
+def test_fleet_rejoin_drill_without_latency_is_a_regression():
+    # the drill RAN (rejoins >= 1) but the recovery number went
+    # missing — a broken emitter, not tolerable absence
+    old = make_round(fleet=_fleet_key())
+    new = make_round(fleet=_fleet_key(rejoin_latency_s=None, rejoins=1))
+    assert ("fleet_rejoin_latency_s", "fleet") in regressions_between(
+        old, new
+    )
 
 
 def test_fleet_only_in_one_round_is_noted_not_failed():

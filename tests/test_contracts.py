@@ -325,6 +325,29 @@ def test_fcycle_budget_fires_on_missing_exchanges(monkeypatch):
     assert r.expected["ppermute_total"] > 0
 
 
+def test_fleet_chaos_fires_on_poisoned_report_and_missing_rejoin():
+    r = check_contract(
+        "fleet-chaos", "xla",
+        expect={"lost": ["chaos-0001"], "rejoins": 0},
+    )
+    assert r.status == "fail" and len(r.violations) == 2
+    msgs = " ".join(v.message for v in r.violations)
+    assert "broke its invariants" in msgs and "chaos-0001" in msgs
+    assert "0 rejoin(s)" in msgs
+
+
+def test_fleet_chaos_fires_on_insensitive_verdict(monkeypatch):
+    # a verdict that ignored a survivability field must be named: probe
+    # a field ok() does not fold over and the sensitivity prong fires
+    monkeypatch.setitem(
+        contracts._FLEET_INVARIANT_PROBES, "replayed", 99
+    )
+    r = check_contract("fleet-chaos", "xla")
+    assert r.status == "fail"
+    assert "ignores invariant field(s) replayed" in r.violations[0].message
+    assert "replayed" in r.actual["insensitive"]
+
+
 def test_check_contract_rejects_unknown_and_inapplicable():
     with pytest.raises(ValueError, match="unknown contract kind"):
         check_contract("no-such-contract", "xla")

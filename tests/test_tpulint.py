@@ -39,7 +39,7 @@ def codes_of(source: str, **cfg) -> list[str]:
 def test_registry_has_all_twenty_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
         "TPU010", "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
-        "TPU016", "TPU017", "TPU018", "TPU019", "TPU020",
+        "TPU016", "TPU017", "TPU018", "TPU019", "TPU020", "TPU021",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -1266,9 +1266,12 @@ def test_tpu011_negative_host_only_and_deadline_patterns():
         solver = jax.jit(lambda x: x + 1)
 
         def host_only(xs):
-            t0 = time.time()
+            # perf_counter, not time.time(): a wall-clock span would be
+            # TPU021's wall-clock-lease finding, which this TPU011
+            # fixture is not about
+            t0 = time.perf_counter()
             total = sum(xs)
-            return time.time() - t0
+            return time.perf_counter() - t0
 
         def deadline(timeout, t0, k):
             if time.monotonic() - t0 > timeout:
@@ -2122,6 +2125,128 @@ def test_tpu020_suppression_comment():
     src = """
         import jax
         s = jax.lax.psum(x, "i")  # tpulint: disable=TPU020
+    """
+    assert lint_at(src, "pkg/obs/m.py") == []
+
+
+# -- TPU021: wall-clock reads in lease/deadline arithmetic ------------------
+
+
+def test_tpu021_positive_wall_clock_in_arithmetic():
+    src = """
+        import time
+        import datetime
+
+        def lease(lease_s):
+            return time.time() + lease_s
+
+        def age(started):
+            return datetime.datetime.now() - started
+    """
+    assert codes_of(src, select=frozenset({"TPU021"})) == [
+        "TPU021", "TPU021",
+    ]
+
+
+def test_tpu021_positive_binding_later_in_arithmetic():
+    src = """
+        import time
+
+        def span(work):
+            t0 = time.time()
+            work()
+            return time.time() - t0
+    """
+    # the t0 binding feeds arithmetic (prong 2) AND the closing read is
+    # an arithmetic operand itself (prong 1)
+    assert codes_of(src, select=frozenset({"TPU021"})) == [
+        "TPU021", "TPU021",
+    ]
+
+
+def test_tpu021_positive_self_attribute_duration():
+    src = """
+        import time
+
+        class Tracker:
+            def start(self):
+                self.t0 = time.time()
+
+            def elapsed(self):
+                return time.time() - self.t0
+    """
+    assert "TPU021" in codes_of(src, select=frozenset({"TPU021"}))
+
+
+def test_tpu021_negative_record_only_timestamps():
+    # the journal/trace idiom: a bare wall-clock read stored in a
+    # record touches no arithmetic and stays silent
+    src = """
+        import time
+
+        def record(rid, records):
+            records[rid] = {"state": "admitted", "t_admit_unix": time.time()}
+
+        def stamp():
+            return {"unix_time": time.time()}
+    """
+    assert codes_of(src, select=frozenset({"TPU021"})) == []
+
+
+def test_tpu021_negative_monotonic_arithmetic_is_fine():
+    src = """
+        import time
+
+        def lease(lease_s):
+            return time.monotonic() + lease_s
+
+        def span(t0):
+            return time.monotonic() - t0
+    """
+    assert codes_of(src, select=frozenset({"TPU021"})) == []
+
+
+def test_tpu021_disjoint_from_tpu016_comparison_scope():
+    # a read INSIDE an ordering comparison is TPU016's finding — TPU021
+    # must stay silent there, and TPU016 must not fire on pure
+    # arithmetic with no comparison (the scopes partition the hazard)
+    compare_src = """
+        import time
+
+        def expired(deadline):
+            return time.time() - deadline > 0
+    """
+    assert codes_of(compare_src, select=frozenset({"TPU021"})) == []
+    assert codes_of(compare_src, select=frozenset({"TPU016"})) == ["TPU016"]
+    arith_src = """
+        import time
+
+        def lease(lease_s):
+            return time.time() + lease_s
+    """
+    assert codes_of(arith_src, select=frozenset({"TPU016"})) == []
+    assert codes_of(arith_src, select=frozenset({"TPU021"})) == ["TPU021"]
+
+
+def test_tpu021_wall_clock_fns_config_knob():
+    src = """
+        import clocklib
+
+        def lease(lease_s):
+            return clocklib.wall_now() + lease_s
+    """
+    assert codes_of(src, select=frozenset({"TPU021"})) == []
+    assert codes_of(
+        src,
+        select=frozenset({"TPU021"}),
+        wall_clock_fns=("clocklib.wall_now",),
+    ) == ["TPU021"]
+
+
+def test_tpu021_suppression_comment():
+    src = """
+        import time
+        AGE = time.time() - 1700000000.0  # tpulint: disable=TPU021
     """
     assert lint_at(src, "pkg/obs/m.py") == []
 

@@ -337,8 +337,10 @@ def test_fleet_table_rendered_when_present(workspace):
         ],
         "non_decreasing": True,
         "handoff_p99_s": 0.0025,
+        "rejoin_latency_s": 0.31,
         "handoffs": 1,
         "adopted": 3,
+        "rejoins": 1,
         "kill_completed": 24,
     })
     artifact.write_text(json.dumps(rec))
@@ -352,6 +354,8 @@ def test_fleet_table_rendered_when_present(workspace):
     # the kill-drill sentence states only what the artifact carries
     assert "24 request(s) completed after the kill" in text
     assert "zero requests lost" not in text
+    assert "kill→first-completed-solve p99 310.00 ms" in text
+    assert "1 rejoin(s)" in text
 
 
 def test_fleet_absent_or_failed_is_supported(workspace):
@@ -372,6 +376,19 @@ def test_fleet_absent_or_failed_is_supported(workspace):
     text = readme.read_text()
     assert "| 2 | 2 | 90 |" in text
     assert "Kill drill" not in text
+    assert "Rejoin drill" not in text
+    # a pre-rejoin artifact (kill drill but no recovery number) renders
+    # the kill line alone
+    artifact.write_text(json.dumps(make_artifact(fleet={
+        "rows": [{"replicas": 2, "lanes": 2, "solves_per_sec": 90.0}],
+        "non_decreasing": True,
+        "handoff_p99_s": 0.002,
+        "handoffs": 1,
+    })))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Kill drill" in text
+    assert "Rejoin drill" not in text
 
 
 def test_regenerate_derives_everything_from_artifact(workspace):

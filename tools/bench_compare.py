@@ -31,8 +31,11 @@ older artifacts predate newer keys, which must never fail the gate):
   (`collectives_identical`) breaking — bench.py's own ≤2% gate bounds
   the absolute; this catches the trend
 - `fleet` rows (keyed by replica count): aggregate `solves_per_sec`
-  through the replicated fleet dropping more than `fleet-agg-pct`, and
-  the `non_decreasing` scaling pin breaking in the new round
+  through the replicated fleet dropping more than `fleet-agg-pct`, the
+  `non_decreasing` scaling pin breaking in the new round, and the
+  kill→rejoin recovery p99 (`rejoin_latency_s`) growing more than
+  `rejoin-p99-pct` (a drill that ran but lost the number is a broken
+  emitter, gated unconditionally)
 - the `grad` row: grad-solves/sec through the scheduler dropping more
   than `grad-pct`, and the per-grid adjoint/primal iteration ratio
   growing past the same band (the adjoint must stay "one extra solve
@@ -97,6 +100,9 @@ DEFAULT_TOLERANCES = {
     # fleet aggregate solves/sec per replica count: the replicated
     # serving layer's throughput shares the serving noise floor
     "fleet-agg-pct": 0.25,
+    # fleet kill→rejoin recovery-time-to-capacity p99: dominated by the
+    # rejoiner's replay + pre-warm compile, so it gets a wide band
+    "rejoin-p99-pct": 0.50,
     # grad key: grad-solves/sec through the scheduler shares the
     # serving noise floor; the adjoint/primal iteration ratio gets the
     # same band (same-operator adjoints must keep tracking the primal)
@@ -424,6 +430,28 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
                 "fleet_non_decreasing", "fleet", 1, 0,
                 "aggregate solves/sec now DECREASES with replica count "
                 "(the scaling pin broke)",
+            ))
+        # the kill→rejoin recovery number: p99 of kill→first-completed-
+        # solve on the rejoined incarnation. One-sided absence is noted
+        # (pre-rejoin artifacts must keep comparing), but a new round
+        # that DID run the drill and lost the number (rejoins executed,
+        # no latency observed) is a broken emitter, not noise.
+        o_rj = old.get("fleet", {}).get("rejoin_latency_s")
+        n_rj = new.get("fleet", {}).get("rejoin_latency_s")
+        if not one_sided("fleet rejoin_latency_s", "fleet", o_rj, n_rj):
+            if o_rj and n_rj is not None:
+                limit = tol["rejoin-p99-pct"]
+                if n_rj > o_rj * (1.0 + limit):
+                    regressions.append(Regression(
+                        "fleet_rejoin_latency_s", "fleet", o_rj, n_rj,
+                        f"+{(n_rj / o_rj - 1):.0%} > {limit:.0%} slower "
+                        "recovery to capacity",
+                    ))
+        if new.get("fleet", {}).get("rejoins", 0) >= 1 and n_rj is None:
+            regressions.append(Regression(
+                "fleet_rejoin_latency_s", "fleet", 1, 0,
+                "rejoin drill ran but observed no recovery latency "
+                "(the emitter broke)",
             ))
     elif bool(old_fleet) != bool(new_fleet):
         notes.append("fleet: only in one round, skipped")

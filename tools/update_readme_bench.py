@@ -388,11 +388,13 @@ def grad_lines(rec: dict) -> list[str]:
 def fleet_lines(rec: dict) -> list[str]:
     """Markdown for the artifact's ``fleet`` key (emitted by bench.py
     since the replicated-serving layer landed): aggregate solves/sec
-    per replica count plus the kill-drill handoff p99. Pre-fleet
+    per replica count plus the kill-drill handoff p99 and (since the
+    survivability layer) the kill→rejoin recovery p99. Pre-fleet
     artifacts lack the key and render without the table; a failed row
-    (no solves_per_sec) is skipped and a missing kill drill renders the
-    table alone — absence and partial are both supported inputs, not
-    errors."""
+    (no solves_per_sec) is skipped, a missing kill drill renders the
+    table alone, and a pre-rejoin artifact renders the kill line
+    without the recovery clause — absence and partial are supported
+    inputs, not errors."""
     fleet = rec.get("fleet")
     if not isinstance(fleet, dict):
         return []
@@ -429,6 +431,14 @@ def fleet_lines(rec: dict) -> list[str]:
             + (f"; {completed} request(s) completed after the kill"
                if completed is not None else "")
             + "."
+        )
+    if fleet.get("rejoin_latency_s") is not None:
+        lines.append(
+            f"Rejoin drill: the victim re-entered as a fresh "
+            f"incarnation ({fleet.get('rejoins', '?')} rejoin(s)) — "
+            f"kill→first-completed-solve p99 "
+            f"{fleet['rejoin_latency_s'] * 1e3:.2f} ms, regression-gated "
+            f"by `rejoin-p99-pct`."
         )
     return lines
 
