@@ -61,6 +61,11 @@ CONTRACT_DEFAULTS: dict = {
     # (zero-lost, zero-double, no cross-epoch co-ownership, no silent
     # starvation) hold, and the verdict is sensitive to each of them
     "fleet_chaos": False,
+    # Krylov recycling (solver.recycle): recycle=None/x0=None trace the
+    # byte-identical default jaxpr, and the sharded deflated init folds
+    # its k deflation dots into ONE stacked psum — 2 psums total (the
+    # stack + zr₀), zero while bodies, for ANY k
+    "recycle": False,
 }
 
 # classical carry width: the history-off loop must keep the original
@@ -117,6 +122,11 @@ CONTRACT_KINDS = {
         "a kill→rejoin fleet drill completes every request exactly once "
         "with no cross-epoch co-ownership, and the chaos verdict is "
         "sensitive to every survivability invariant field"
+    ),
+    "recycle-deflation": (
+        "recycle=None/x0=None trace the byte-identical default jaxpr; "
+        "the sharded deflated init holds exactly 2 psums (k dots folded "
+        "into one stack) and zero while bodies for any k"
     ),
 }
 
@@ -696,6 +706,71 @@ def _check_fleet_chaos(engine, spec, problem, dtype, expect=None, **_):
     )
 
 
+def _check_recycle_deflation(engine, spec, problem, dtype, mesh_shape,
+                             **_):
+    """Both halves of the recycling contract. Off-path: ``recycle=None``
+    + ``x0=None`` must trace the byte-identical jaxpr of the default
+    solve (the ring capture is free when off). On-path: the sharded
+    deflated init (``solver.recycle.build_deflated_sharded_init``) must
+    hold exactly 2 psums — the k deflation dots Wᵀ·rhs folded into ONE
+    stacked psum, plus the carry's zr₀ — and ZERO while bodies,
+    independent of k (deflation lives entirely outside the loop; the
+    advance cadence is the collective-cadence cell's, unchanged)."""
+    from poisson_ellipse_tpu.ops import assembly
+    from poisson_ellipse_tpu.parallel.mesh import padded_dims
+    from poisson_ellipse_tpu.solver import recycle
+    from poisson_ellipse_tpu.solver.pcg import pcg
+
+    a, b, rhs = assembly.assemble(problem, dtype)
+    base = jaxpr_scan.trace_text(lambda *o: pcg(problem, *o), (a, b, rhs))
+    off = jaxpr_scan.trace_text(
+        lambda *o: pcg(problem, *o, x0=None, recycle=None), (a, b, rhs)
+    )
+    identical = base == off
+    msgs = []
+    if not identical:
+        msgs.append(
+            "recycle=None/x0=None traces a different jaxpr than the "
+            "default solve — the capture axis is not free when off"
+        )
+    mesh = _mesh(mesh_shape)
+    g1p, g2p = padded_dims(problem.node_shape, mesh)
+    init_fn = recycle.build_deflated_sharded_init(
+        problem, mesh=mesh, dtype=dtype
+    )
+    grid = jax.ShapeDtypeStruct((g1p, g2p), dtype)
+    per_k = {}
+    for k in (2, 8):
+        closed = jaxpr_scan.trace(
+            init_fn,
+            (grid, grid, grid,
+             jax.ShapeDtypeStruct((k, g1p, g2p), dtype),
+             jax.ShapeDtypeStruct((k, k), dtype)),
+        )
+        counts = jaxpr_scan.count_primitives(
+            closed.jaxpr, jaxpr_scan.COLLECTIVE_PRIMS
+        )
+        psum = counts.get("psum", 0) + counts.get("psum_invariant", 0)
+        bodies = len(jaxpr_scan.while_bodies(closed.jaxpr))
+        per_k[k] = {"psum": psum, "whiles": bodies}
+        if psum != 2:
+            msgs.append(
+                f"deflated sharded init holds {psum} psum(s) at k={k}; "
+                "the fold promises exactly 2 (stacked Wᵀr + zr₀) for "
+                "any k"
+            )
+        if bodies != 0:
+            msgs.append(
+                f"deflated sharded init holds {bodies} while bodies at "
+                f"k={k}; deflation must stay entirely outside the loop"
+            )
+    return _result(
+        "recycle-deflation", engine,
+        {"identical": True, "init_psums": 2, "init_whiles": 0},
+        {"identical": identical, "per_k": per_k}, msgs,
+    )
+
+
 _CHECKERS = {
     "single-collective-free": _check_single_collective_free,
     "collective-cadence": _check_collective_cadence,
@@ -708,6 +783,7 @@ _CHECKERS = {
     "history-resident": _check_history_resident,
     "fcycle-budget": _check_fcycle_budget,
     "fleet-chaos": _check_fleet_chaos,
+    "recycle-deflation": _check_recycle_deflation,
 }
 
 
@@ -730,6 +806,7 @@ def contract_applies(kind: str, engine: str,
         "history-resident": spec["history_resident"],
         "fcycle-budget": spec["fcycle_budget"],
         "fleet-chaos": spec["fleet_chaos"],
+        "recycle-deflation": spec["recycle"],
     }[kind]
 
 

@@ -42,6 +42,7 @@ AXIS_CONTRACTS = {
     "storage": ("storage-identity", "storage-narrow"),
     "history": ("history-free", "history-resident"),
     "fleet": ("fleet-chaos",),
+    "recycle": ("recycle-deflation",),
 }
 AXES = tuple(AXIS_CONTRACTS)
 

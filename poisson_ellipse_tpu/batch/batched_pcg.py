@@ -149,7 +149,7 @@ def lane_dots(*pairs):
 
 
 def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None,
-               storage_dtype=None):
+               storage_dtype=None, x0=None):
     """The batched PCG carry at iteration 0.
 
     Layout: (k, w, r, p, zr, diff, converged, breakdown, quarantined,
@@ -158,7 +158,12 @@ def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None,
     ``h1``/``h2`` may be traced overrides (the bucket-generic path);
     they default to the problem's. ``storage_dtype`` stores the lane
     fields (w, r, p) at that width (``ops.precision``) — the per-lane
-    scalars stay at compute width.
+    scalars stay at compute width. ``x0`` is an optional per-lane warm
+    start (B, g1, g2): the carry starts from it with the TRUE residual
+    rhs − A·x0 (the ``solver.pcg.init_state`` warm-start contract, per
+    lane — a wrong guess costs iterations, never correctness), masked
+    to the embedded interior so bucket padding stays exactly zero.
+    ``x0=None`` leaves every expression of the cold path untouched.
     """
     dtype = rhs.dtype
     st = resolve_storage_dtype(storage_dtype, dtype)
@@ -167,12 +172,22 @@ def init_state(problem: Problem, a, b, rhs, mask=None, h1=None, h2=None,
     h2 = jnp.asarray(problem.h2 if h2 is None else h2, dtype)
     a3, b3, m3 = _lane_ops(a, b, mask)
     d = diag_d_batched(a3, b3, h1, h2, m3)
-    r0 = rhs
+    if x0 is None:
+        r0 = rhs
+        w0 = jnp.zeros_like(rhs, dtype=st or rhs.dtype)
+    else:
+        w0 = jnp.asarray(x0, dtype)
+        if m3 is not None:
+            w0 = w0 * m3
+        r0 = rhs - apply_a_batched(w0, a3, b3, h1, h2)
+        if m3 is not None:
+            r0 = r0 * m3
+        w0 = _pstore(w0, st) if st is not None else w0
     z0 = apply_dinv_batched(r0, d)
     zr0 = jnp.sum(z0 * r0, axis=(1, 2)) * h1 * h2
     return (
         jnp.asarray(0, jnp.int32),
-        jnp.zeros_like(rhs, dtype=st or rhs.dtype),
+        w0,
         _pstore(r0, st),
         _pstore(z0, st),  # p0 = z0
         zr0,

@@ -34,6 +34,12 @@ The serving surface:
   lane-batched engines (real batching — ``--batch`` is only the chained
   TIMING protocol and never puts more work on the chip); reports carry
   aggregate solves/sec and per-lane quarantine counts.
+- ``--recycle [CAP]`` / ``--warm-start`` run the Krylov-recycling
+  protocol (``solver.recycle`` / ``runtime.solvecache``): one untimed
+  ring-carrying capture solve harvests the extremal Ritz deflation
+  basis, then the timed solve restarts deflated and/or seeded with the
+  capture solution (the semantic-cache-hit shape) — the report's
+  ``iters`` is the deflated count, its l2 still checked vs analytic.
 - ``warmup`` is the cache subcommand: wire the persistent XLA
   compilation cache and AOT-compile bucketed batched executables so
   arbitrary request sizes hit a warm executable —
@@ -951,6 +957,12 @@ def _run_serve(argv: list[str]) -> int:
     )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--dtype", choices=sorted(DTYPES), default="f32")
+    ap.add_argument(
+        "--warm-start", action="store_true",
+        help="per-bucket solve-cache pools (runtime.solvecache): "
+        "consult on admission, deposit on retirement; replays always "
+        "run cold (solvecache_hit_total / recycle:hit on the trace)",
+    )
     ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
     ap.add_argument(
         "--metrics", metavar="FILE",
@@ -979,7 +991,7 @@ def _run_serve(argv: list[str]) -> int:
                 queue_capacity=args.queue_capacity,
                 dtype=resolve_dtype(args.dtype),
                 max_retries=args.retries, journal=args.journal,
-                keep_solutions=False,
+                keep_solutions=False, warm_start=args.warm_start,
             )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
@@ -1196,6 +1208,14 @@ def _run_chaos(argv: list[str]) -> int:
         "--deadline", type=float, default=None, metavar="SECONDS",
         help="per-request deadline for the stream",
     )
+    ap.add_argument(
+        "--warm-start", action="store_true",
+        help="run the drill with the per-bucket recycle pools ON "
+        "(runtime.solvecache) and a cache_poison fault armed on one "
+        "request: the zero-lost/zero-double/all-classified triple must "
+        "hold unchanged with recycling enabled, and the poisoned "
+        "consult may only cost iterations",
+    )
     ap.add_argument("--trace", metavar="FILE", help="JSONL trace sink")
     ap.add_argument("--json", action="store_true", help="one JSON line")
     args = ap.parse_args(argv)
@@ -1224,6 +1244,10 @@ def _run_chaos(argv: list[str]) -> int:
                 deadline_s=args.deadline,
                 mesh_kill_request=(
                     max(args.requests // 3, 1) if args.mesh else None
+                ),
+                warm_start=args.warm_start,
+                poison_request=(
+                    max(args.requests // 4, 1) if args.warm_start else None
                 ),
             )
         except ValueError as e:
@@ -1641,6 +1665,30 @@ def main(argv=None) -> int:
         "them",
     )
     ap.add_argument(
+        "--recycle",
+        type=int,
+        nargs="?",
+        const=-1,  # bare flag → solver.recycle.RECYCLE_CAP, resolved below
+        default=None,
+        metavar="CAP",
+        help="Krylov recycling (solver.recycle): one untimed ring-"
+        "carrying capture solve harvests the extremal Ritz deflation "
+        "basis, then the timed solve restarts deflated (x0 = the "
+        "Galerkin projection of the rhs) — the report's iters is the "
+        "deflated count. CAP is the Lanczos-vector ring capacity "
+        "(default: solver.recycle.RECYCLE_CAP); rides the single-device "
+        "xla engine. Correctness never depends on the basis: any x0 is "
+        "verified by its TRUE residual at init",
+    )
+    ap.add_argument(
+        "--warm-start",
+        action="store_true",
+        help="seed the timed solve with a prior solve's solution — the "
+        "semantic-cache-hit shape (runtime.solvecache); stacks on "
+        "--recycle (the hit is deflated against its true residual). "
+        "Warm-started solution bits legitimately differ from cold",
+    )
+    ap.add_argument(
         "--checkpoint-dir",
         help="persist the PCG carry here every --chunk iterations and "
         "resume from it after a kill (single and sharded modes; sharded "
@@ -1808,6 +1856,12 @@ def _run_cli(args) -> int:
     if args.geometry is None and args.theta is not None:
         print("error: --theta needs --geometry", file=sys.stderr)
         return 2
+    recycle_cap = args.recycle
+    if recycle_cap is not None and recycle_cap < 0:
+        # bare --recycle: the product default ring capacity
+        from poisson_ellipse_tpu.solver.recycle import RECYCLE_CAP
+
+        recycle_cap = RECYCLE_CAP
 
     if args.threads_sweep:
         if args.mode != "native":
@@ -1906,6 +1960,8 @@ def _run_cli(args) -> int:
                         theta=args.theta,
                         storage_dtype=args.storage_dtype,
                         sstep_s=args.sstep_s,
+                        recycle=recycle_cap,
+                        warm_start=args.warm_start,
                     )
             except SolveError as e:
                 # the classified exit contract: the trace keeps every

@@ -1,4 +1,4 @@
-"""The tpulint rule registry: TPU001–TPU021.
+"""The tpulint rule registry: TPU001–TPU022.
 
 Each rule is a generator over a :class:`~poisson_ellipse_tpu.lint.visitor.
 Module`, yielding :class:`~poisson_ellipse_tpu.lint.report.Finding`s.
@@ -102,6 +102,13 @@ silent — a lint gate that cries wolf gets deleted from CI.
 |        |                    | or deadline COMPUTED from the wall clock is   |
 |        |                    | stepped by NTP before any comparison happens; |
 |        |                    | bare record-only timestamps stay silent       |
+| TPU022 | unbounded-cache    | a module/class-level cache-named dict (name   |
+|        |                    | contains cache/memo/pool) grown by key        |
+|        |                    | assignment or setdefault with no eviction     |
+|        |                    | route (pop/popitem/clear/del/rebind) — the    |
+|        |                    | cache grows with the key space, not the       |
+|        |                    | working set; TPU012's mapping sibling (the    |
+|        |                    | solvecache LRU-cap discipline, fenced)        |
 """
 
 from __future__ import annotations
@@ -2980,3 +2987,226 @@ def check_wall_clock_lease(module: Module,
                 "stepped by NTP before anything compares it — bind "
                 "`time.monotonic()` for anything that feeds arithmetic",
             ))
+
+
+# --------------------------------------------------------------------------
+# TPU022 — unbounded dict caches in long-lived serving/runtime code
+# --------------------------------------------------------------------------
+
+# bindings whose NAME declares cache intent — the conservative gate: a
+# dict that is not named like a cache is somebody's data structure, not
+# this rule's business (a lint gate that cries wolf gets deleted)
+_CACHE_NAME_MARKERS = ("cache", "memo", "pool")
+
+# dict mutations that grow / that evict
+_CACHE_GROW = frozenset({"setdefault", "update"})
+_CACHE_EVICT = frozenset({"pop", "popitem", "clear"})
+
+
+def _cache_named(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _CACHE_NAME_MARKERS)
+
+
+def _dict_ctor(module: Module, node: ast.AST) -> Optional[str]:
+    """"dict"/"OrderedDict" when ``node`` constructs an empty mapping —
+    ``{}``, ``dict()``, ``OrderedDict()``, or ``dataclasses.field(
+    default_factory=dict|OrderedDict)`` — else None."""
+    if isinstance(node, ast.Dict) and not node.keys:
+        return "dict"
+    if not isinstance(node, ast.Call):
+        return None
+    leaf = (module.qualname(node.func) or "").rsplit(".", 1)[-1]
+    if leaf in ("dict", "OrderedDict") and not node.args and not node.keywords:
+        return leaf
+    if leaf == "field":
+        for kw in node.keywords:
+            if (
+                kw.arg == "default_factory"
+                and isinstance(kw.value, ast.Name)
+                and kw.value.id in ("dict", "OrderedDict")
+            ):
+                return kw.value.id
+    return None
+
+
+def _cache_usage(scope: ast.AST, matches,
+                 exclude: set = frozenset(),
+                 defining: ast.AST | None = None) -> tuple[bool, bool]:
+    """(grows, evicts) for a candidate cache binding within ``scope``.
+
+    Grows: ``c[k] = v`` subscript assignment, ``c.setdefault(...)``,
+    ``c.update(...)``. Evicts: ``c.pop/popitem/clear``, ``del c[k]``,
+    or a rebinding to a fresh empty container (the drop-the-pool
+    idiom). The same visibility discipline as TPU012's
+    :func:`_queue_usage` — ``exclude`` subtrees (shadowing scopes) are
+    not descended into — but with the subscript-assignment polarity
+    FLIPPED: for a list, ``q[i] = x`` is the windowed-drain bound; for
+    a dict, ``c[k] = v`` is exactly the unbounded admission this rule
+    exists to fence."""
+    grows = evicts = False
+    for node in _walk_excluding(scope, exclude):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if matches(node.func.value):
+                if node.func.attr in _CACHE_GROW:
+                    grows = True
+                elif node.func.attr in _CACHE_EVICT:
+                    evicts = True
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript) and matches(
+                    target.value
+                ):
+                    evicts = True
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            value = node.value
+            for target in targets:
+                if isinstance(target, ast.Subscript) and matches(
+                    target.value
+                ):
+                    grows = True
+                elif (
+                    node is not defining
+                    and matches(target)
+                    and value is not None
+                    and _empty_container_expr(value)
+                ):
+                    evicts = True
+    return grows, evicts
+
+
+@rule(
+    "TPU022",
+    "unbounded-cache",
+    "a module/class-level cache-named dict grown by key assignment with "
+    "no eviction route — every distinct key a long-lived server sees "
+    "stays resident forever",
+)
+def check_unbounded_cache(module: Module,
+                          config: LintConfig) -> Iterator[Finding]:
+    """TPU012's mapping sibling: the cache-discipline rule.
+
+    A compile cache, solve cache or warm pool that lives at module or
+    instance scope and admits entries (``c[key] = value``,
+    ``setdefault``) without any eviction route grows with the *key
+    space*, not the working set — in a serving process where keys carry
+    request-derived content (grid buckets are finite; RHS sketches are
+    not), that is an OOM with a delay fuse. The repo's own discipline
+    is the fix this rule points at: ``runtime.solvecache.SolveCache``
+    (LRU key cap + per-key ring), ``runtime.compile_cache`` (bounded
+    bucketing), or a drop-and-rebuild (``_ctxs.clear()`` on mesh
+    degrade).
+
+    Deliberately conservative, mirroring TPU012's machinery:
+
+    - candidates are long-lived bindings only — module-level names and
+      ``self`` attributes (incl. ``field(default_factory=dict)``)
+      initialised to ``{}``/``dict()``/``OrderedDict()`` — whose NAME
+      declares cache intent (contains ``cache``/``memo``/``pool``); a
+      dict not named like a cache is a data structure, not a finding;
+    - any visible eviction silences it: ``pop``/``popitem``/``clear``,
+      ``del c[key]``, or a rebinding to a fresh empty container;
+      function-local caches are scoped to one call and stay silent
+      (TPU012's shadowing discipline, reused verbatim).
+    """
+    # module-level names
+    for stmt in module.tree.body:
+        target = value = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+            isinstance(stmt.targets[0], ast.Name)
+        ):
+            target, value = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ) and stmt.value is not None:
+            target, value = stmt.target, stmt.value
+        if target is None or not _cache_named(target.id):
+            continue
+        kind = _dict_ctor(module, value)
+        if kind is None:
+            continue
+        name = target.id
+
+        def matches(expr, name=name):
+            return isinstance(expr, ast.Name) and expr.id == name
+
+        grows, evicts = _cache_usage(
+            module.tree, matches,
+            exclude=_shadowing_functions(module.tree, name),
+            defining=stmt,
+        )
+        if grows and not evicts:
+            yield _finding(
+                module,
+                stmt,
+                "TPU022",
+                f"module-level {kind} cache `{name}` admits entries with "
+                "no eviction route: every distinct key stays resident "
+                "for the life of the process — bound it (LRU cap like "
+                "runtime.solvecache.SolveCache, a popitem ring, a "
+                "clear() on rebuild) or key it by a finite bucket space",
+            )
+    # class-level / instance attributes
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        candidates: dict[str, tuple[ast.AST, str]] = {}
+        for stmt in cls.body:
+            target = value = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+                isinstance(stmt.targets[0], ast.Name)
+            ):
+                target, value = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ) and stmt.value is not None:
+                target, value = stmt.target, stmt.value
+            if target is None or not _cache_named(target.id):
+                continue
+            kind = _dict_ctor(module, value)
+            if kind is not None:
+                candidates[target.id] = (stmt, kind)
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                pairs = [(t, node.value) for t in node.targets]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                pairs = [(node.target, node.value)]
+            else:
+                continue
+            for target, value in pairs:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and _cache_named(target.attr)
+                ):
+                    kind = _dict_ctor(module, value)
+                    if kind is not None and target.attr not in candidates:
+                        candidates[target.attr] = (node, kind)
+        for attr, (site, kind) in candidates.items():
+
+            def matches(expr, attr=attr, cls_name=cls.name):
+                return _attr_is_self(expr, attr) or (
+                    isinstance(expr, ast.Attribute)
+                    and expr.attr == attr
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == cls_name
+                )
+
+            grows, evicts = _cache_usage(cls, matches, defining=site)
+            if grows and not evicts:
+                yield _finding(
+                    module,
+                    site,
+                    "TPU022",
+                    f"instance-level {kind} cache `{attr}` of class "
+                    f"`{cls.name}` admits entries with no eviction "
+                    "route: the cache grows with the key space, not the "
+                    "working set — bound it (LRU cap + per-key ring "
+                    "like runtime.solvecache.SolveCache) or drop and "
+                    "rebuild it at a lifecycle boundary",
+                )

@@ -50,6 +50,7 @@ __all__ = [
     "eigenvalue_bounds",
     "lanczos_tridiagonal",
     "predicted_iterations",
+    "ritz_decomposition",
     "ritz_values",
     "spectrum_report",
 ]
@@ -177,16 +178,60 @@ def _eigh_tridiagonal(d: np.ndarray, e: np.ndarray, vectors: bool = False):
 def ritz_values(trace) -> np.ndarray:
     """Ascending Ritz values of M⁻¹A from the trace (empty when the
     trace holds no usable iteration)."""
+    vals, _ = ritz_decomposition(trace)
+    return vals
+
+
+def ritz_decomposition(
+    trace, max_steps: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(ascending Ritz values θ, T_m eigenvectors Y) — the vectors form.
+
+    ``Y[:, i]`` expresses the i-th Ritz vector in the Lanczos basis of
+    the Krylov space the solve walked, so any stored spanning set of
+    that space (``solver.recycle``'s direction ring) turns it into an
+    approximate Ritz vector of M⁻¹A: the deflation basis W = P·Y that
+    Krylov recycling projects out of the *next* related solve. Returns
+    ``(empty, empty)`` when the trace holds no usable step; columns are
+    sorted with their values.
+
+    ``max_steps`` truncates the reconstruction to the leading Lanczos
+    steps — T_j is itself the Lanczos matrix of the j-step process, so
+    a consumer holding only the first j basis vectors (a bounded
+    direction ring) gets the decomposition matching what it stored
+    rather than coefficients it cannot apply.
+    """
     d, e = lanczos_tridiagonal(trace)
+    if max_steps is not None and d.size > max_steps:
+        d, e = d[:max_steps], e[: max(max_steps - 1, 0)]
     if d.size == 0:
-        return np.empty(0)
-    vals, _ = _eigh_tridiagonal(d, e)
-    return np.sort(vals)
+        return np.empty(0), np.empty((0, 0))
+    vals, vecs = _eigh_tridiagonal(d, e, vectors=True)
+    order = np.argsort(vals)
+    if vecs is None:  # unreachable with both scipy and numpy backends
+        vecs = np.eye(d.size)
+    return vals[order], vecs[:, order]
+
+
+def extremal_indices(m: int, k: int) -> np.ndarray:
+    """Indices of the ``k`` extremal entries of an ascending length-``m``
+    spectrum: the low end first (the modes that dominate CG's iteration
+    count), the top for the remainder (the cut-cell outliers the
+    fictitious-domain blend creates). The one selection rule shared by
+    the recycling harvest (``solver.recycle``) and the deflated
+    predictor below — the prediction must model the same modes the
+    deflation removes."""
+    k = max(0, min(int(k), int(m)))
+    lo = (k + 1) // 2
+    hi = k - lo
+    return np.concatenate(
+        [np.arange(lo), np.arange(m - hi, m)]
+    ).astype(np.intp)
 
 
 def predicted_iterations(
     trace, delta: float, diff0: float | None = None,
-    max_model_iters: int | None = None,
+    max_model_iters: int | None = None, deflated_k: int = 0,
 ) -> int | None:
     """Iterations until the step norm crosses ``delta``, predicted by
     replaying scalar CG on the Ritz model problem.
@@ -200,6 +245,14 @@ def predicted_iterations(
     interior of the spectrum and overpredicts ~75% here). Returns None
     when the model never reaches the target within ``max_model_iters``
     (default 4m) — e.g. a tolerance beyond what m Ritz values resolve.
+
+    The base model assumes a ZERO initial guess — the prediction for a
+    warm-started (recycled) solve would be dishonest. ``deflated_k``
+    makes it honest for the deflated warm start ``solver.recycle``
+    builds: the k extremal Ritz components (``extremal_indices`` — the
+    same modes the harvest keeps) are removed from the model's initial
+    residual, so the replay runs on the deflated interval and predicts
+    the recycled solve, not the cold one.
     """
     v = _valid_series(trace)
     if diff0 is None:
@@ -212,6 +265,13 @@ def predicted_iterations(
         return None
     theta, vecs = _eigh_tridiagonal(d, e, vectors=True)
     weights = vecs[0, :] ** 2 if vecs is not None else np.full(m, 1.0 / m)
+    if deflated_k > 0:
+        if deflated_k >= m:
+            return None  # the whole model deflated: nothing left to predict
+        weights = weights.copy()
+        weights[extremal_indices(m, deflated_k)] = 0.0
+        if not np.any(weights > 0):
+            return None
     # scalar CG on A = diag(θ) with r0 components √w — exact arithmetic
     # (f64), no arrays bigger than m
     r = np.sqrt(np.maximum(weights, 0.0))
@@ -284,7 +344,7 @@ def detect_plateaus(
 
 def spectrum_report(
     trace, delta: float, actual_iters: int | None = None,
-    plateau_window: int | None = None,
+    plateau_window: int | None = None, deflated_k: int = 0,
 ) -> dict:
     """One JSON-able spectral record for a solve's trace.
 
@@ -295,6 +355,13 @@ def spectrum_report(
     prediction); ``predicted_iters`` — the sharp Ritz-model replay;
     ``predicted_err`` vs ``actual_iters`` (defaults to the trace's
     iteration count); ``plateaus`` spans and the ``stagnated`` flag.
+
+    ``deflated_k`` > 0 marks the trace as feeding a k-mode Krylov-
+    recycled warm start (``solver.recycle``): ``predicted_iters`` is
+    then the DEFLATED-interval replay and the record carries an extra
+    ``predicted_iters_recycled`` alongside the cold prediction — a
+    recycled solve judged against the zero-start prediction would read
+    as a false regression (or a false win) in ``harness diagnose``.
     """
     v = _valid_series(trace)
     n = int(v["diff"].size)
@@ -318,7 +385,13 @@ def spectrum_report(
     iters_bound = None
     if 0 < rate < 1 and diff0 > 0 and 0 < delta < diff0:
         iters_bound = int(math.ceil(math.log(delta / diff0) / math.log(rate)))
-    predicted = predicted_iterations(trace, delta, diff0=diff0)
+    cold = predicted_iterations(trace, delta, diff0=diff0)
+    recycled = (
+        predicted_iterations(trace, delta, diff0=diff0,
+                             deflated_k=deflated_k)
+        if deflated_k > 0 else None
+    )
+    predicted = recycled if deflated_k > 0 else cold
     plateaus = detect_plateaus(v["diff"], window=plateau_window)
     return {
         "available": True,
@@ -335,6 +408,12 @@ def spectrum_report(
             round(predicted / actual_iters - 1.0, 4)
             if predicted is not None and actual_iters
             else None
+        ),
+        **(
+            {"deflated_k": int(deflated_k),
+             "predicted_iters_cold": cold,
+             "predicted_iters_recycled": recycled}
+            if deflated_k > 0 else {}
         ),
         "plateaus": [[int(a), int(b)] for a, b in plateaus],
         "stagnated": bool(plateaus),
@@ -364,11 +443,20 @@ def render_report(rep: dict) -> str:
         )
     if rep.get("predicted_iters") is not None:
         err = rep.get("predicted_err")
+        model = (
+            f"deflated Ritz-model replay, k={rep['deflated_k']}"
+            if rep.get("deflated_k") else "Ritz-model replay"
+        )
         lines.append(
             f"  predicted iters       {rep['predicted_iters']}  "
-            f"(Ritz-model replay; actual {rep['actual_iters']}"
+            f"({model}; actual {rep['actual_iters']}"
             + (f", {err:+.1%}" if err is not None else "")
             + ")"
+        )
+    elif rep.get("deflated_k"):
+        lines.append(
+            "  predicted iters       n/a (warm start deflated past the "
+            "model's resolution — cold prediction skipped as dishonest)"
         )
     if rep.get("plateaus"):
         spans = ", ".join(f"{a}..{b}" for a, b in rep["plateaus"])

@@ -207,6 +207,24 @@ def engine_report(
     # psum and its invariant-spelled twin are one collective on the wire
     psum = counts.get("psum", 0) + counts.get("psum_invariant", 0)
     per_body = iters_per_loop_body(engine, sstep_s)
+    # Krylov-recycling footprint: engines whose contract row declares the
+    # recycle cell (solver.engine.ENGINE_CAPS) report the modeled HBM
+    # bytes of the default-capacity Lanczos ring. A MODEL only — the
+    # ring is opt-in (pcg(recycle=cap)); the default build traced above
+    # carries no ring, which is exactly why the psum/ppermute columns
+    # are unchanged by it (the recycle contract cell's jaxpr-pinned fact)
+    from poisson_ellipse_tpu.solver.engine import ENGINE_CAPS
+
+    ring_bytes = None
+    ring_cap = None
+    if ENGINE_CAPS.get(engine, {}).get("contracts", {}).get("recycle"):
+        from poisson_ellipse_tpu.solver.recycle import (
+            RECYCLE_CAP,
+            ring_model_bytes,
+        )
+
+        ring_cap = RECYCLE_CAP
+        ring_bytes = ring_model_bytes(problem, cap=ring_cap, dtype=dtype)
     return {
         "engine": engine,
         "mode": mode,
@@ -230,6 +248,8 @@ def engine_report(
         "hbm_bytes_per_iter_est": cost["bytes_accessed"] if cost else None,
         "modeled_passes_per_iter": passes,
         "modeled_hbm_bytes_per_iter": modeled_bytes,
+        "recycle_ring_cap": ring_cap,
+        "recycle_ring_model_bytes": ring_bytes,
     }
 
 
@@ -312,4 +332,11 @@ def render_report(rep: dict) -> str:
                 f"  measured-vs-modeled      {hbm / modeled:.2f}x "
                 "(XLA estimate / roofline model)"
             )
+    ring = rep.get("recycle_ring_model_bytes")
+    if ring is not None:
+        lines.append(
+            f"  recycle ring (opt-in)    {ring:.3e} bytes modeled "
+            f"(cap {rep['recycle_ring_cap']} full grids, solver.recycle; "
+            "loop psum/ppermute counts above are unchanged by it)"
+        )
     return "\n".join(lines)

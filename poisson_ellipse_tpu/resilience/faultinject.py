@@ -98,6 +98,7 @@ FAULT_KINDS = (
     "malformed_spec", "degenerate_geometry",
     "replica_kill", "replica_hang", "lease_clock_skew",
     "lease_store_outage", "lease_store_latency",
+    "cache_poison",
 )
 
 # dispatch-level faults: consulted by the driver holding the dispatch
@@ -123,6 +124,16 @@ REPLICA_KINDS = ("replica_kill", "replica_hang", "lease_clock_skew")
 # exercised. ``delay_s`` carries the outage duration (outage) or the
 # per-round-trip stall (latency).
 LEASE_STORE_KINDS = ("lease_store_outage", "lease_store_latency")
+
+# warm-start faults: consulted by the serve scheduler when it consults
+# the solve cache for the addressed request (``serve.scheduler``) — the
+# hit (or the empty slot) is replaced with a deliberately WRONG cached
+# solution, so the drill exercises the semantic cache's whole defense:
+# the true-residual init makes a poisoned x0 cost iterations only, the
+# admission check flags it as a ``recycle:bad-hit`` trace event, and
+# the answer still converges to the same l2 — never a wrong result,
+# never a guard escalation
+CACHE_KINDS = ("cache_poison",)
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -321,6 +332,28 @@ def degenerate_geometry(theta: float | None = None,
     (``theta`` at its default) the request must SOLVE cleanly — the
     drill asserts the clamp, not a rejection."""
     return Fault("degenerate_geometry", request_id=request_id, theta=theta)
+
+
+def cache_poison(request_id: str | None = None) -> Fault:
+    """Replace the addressed request's solve-cache consult with a
+    deliberately wrong cached solution (:func:`poisoned_guess`) — the
+    stale/corrupted-cache-entry drill. The scheduler's warm-start
+    admission must flag it (``recycle:bad-hit``) and the solve must
+    still converge to the same l2, with extra iterations as the only
+    cost (the semantic cache's correctness contract)."""
+    return Fault("cache_poison", request_id=request_id)
+
+
+def poisoned_guess(shape, np_dtype):
+    """The deterministic wrong warm start ``cache_poison`` injects: a
+    large-amplitude checkerboard (boundary ring included — the init's
+    interior mask must neutralise it). Far from ANY smooth Poisson
+    solution, so the bad-hit ratio check trips unambiguously, and
+    seed-free deterministic so replays of the drill are bit-identical."""
+    import numpy as np
+
+    idx = np.indices(shape).sum(axis=0)
+    return (np.where(idx % 2 == 0, 1e3, -1e3)).astype(np_dtype)
 
 
 def replica_kill(at_request: int = 0, replica: int = 0) -> Fault:

@@ -94,6 +94,13 @@ class TunedConfig:
     static_predicted_t_s: float | None = None
     measured_t_s: float | None = None
     static_measured_t_s: float | None = None
+    # Krylov-recycling verdict for the serve lanes (``solver.recycle``):
+    # True when the deflated Ritz replay predicts a warm start cuts the
+    # diagonal iteration count by at least SELECT_MARGIN for this shape.
+    # Advisory — the scheduler's ``warm_start`` stays an explicit opt-in
+    # because warm-started solution bits legitimately differ from cold.
+    recycle: bool = False
+    predicted_iters_recycled: float | None = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -263,6 +270,7 @@ def collect_telemetry(problem: Problem, dtype=jnp.float32, geometry=None,
 
     from poisson_ellipse_tpu.obs import spectrum as obs_spectrum
     from poisson_ellipse_tpu.solver.engine import build_solver
+    from poisson_ellipse_tpu.solver.recycle import RECYCLE_K
 
     probe = _dc.replace(
         problem, max_iter=min(probe_iters, problem.max_iterations)
@@ -270,8 +278,13 @@ def collect_telemetry(problem: Problem, dtype=jnp.float32, geometry=None,
     solver, args, _ = build_solver(probe, "xla", dtype, history=True,
                                    geometry=geometry, theta=theta)
     result, trace = solver(*args)
+    # deflated_k marks the report as ALSO predicting the k-mode
+    # recycled warm start — predicted_iters_cold/-_recycled arrive as a
+    # pair, and scoring below keeps the cold figure as predicted_iters
+    # so the per-solve cost model's semantics are unchanged
     spec = obs_spectrum.spectrum_report(
-        trace, delta=problem.delta, actual_iters=int(result.iters)
+        trace, delta=problem.delta, actual_iters=int(result.iters),
+        deflated_k=RECYCLE_K,
     )
     gbps = None
     if measure_gbps:
@@ -291,7 +304,12 @@ def collect_telemetry(problem: Problem, dtype=jnp.float32, geometry=None,
         "delta": problem.delta,
         "kappa": spec.get("kappa") if spec.get("available") else None,
         "predicted_iters": (
-            spec.get("predicted_iters") if spec.get("available") else None
+            spec.get("predicted_iters_cold")
+            if spec.get("available") else None
+        ),
+        "predicted_iters_recycled": (
+            spec.get("predicted_iters_recycled")
+            if spec.get("available") else None
         ),
         "probe_iters": int(result.iters),
         "probe_converged": bool(result.converged),
@@ -313,6 +331,17 @@ def _diag_iters(problem: Problem, telemetry: dict) -> float:
         # the CG error bound: iters ≈ ½√κ ln(2/δ)
         return 0.5 * math.sqrt(kappa) * math.log(2.0 / problem.delta)
     return float(max(telemetry.get("probe_iters") or 1, 1))
+
+
+def _recycled_iters(problem: Problem,
+                    telemetry: dict) -> Optional[float]:
+    """Ritz-predicted diagonal iterations AFTER the k-mode deflated warm
+    start (``solver.recycle``), or None when the probe's trace could not
+    support the deflated replay — there is deliberately no κ fallback
+    here: a recycling win must be predicted from the measured spectrum
+    or not claimed at all."""
+    pred = telemetry.get("predicted_iters_recycled")
+    return float(pred) if pred else None
 
 
 def _mg_iters(problem: Problem) -> float:
@@ -454,12 +483,28 @@ def select(problem: Problem, telemetry: dict, dtype=jnp.float32,
     serve_chunk = int(min(128, max(
         8, round(_diag_iters(problem, telemetry) / 4)
     )))
+    # the recycling verdict rides the DIAGONAL prediction pair, same
+    # reasoning as the chunk: the scheduler's lanes run the batched diag
+    # engine regardless of the single-solve winner, so the warm-start
+    # payoff is the cold-vs-deflated gap of that engine, not the
+    # winner's. Recycling must clear the same noise-floor margin a
+    # candidate engine must — a marginal predicted cut keeps cold.
+    cold_iters = _diag_iters(problem, telemetry)
+    rec_iters = _recycled_iters(problem, telemetry)
+    recycle = bool(
+        rec_iters is not None
+        and rec_iters < cold_iters * (1.0 - SELECT_MARGIN)
+    )
     chosen = TunedConfig(
         engine=best["engine"], knobs=dict(best["knobs"], chunk=serve_chunk),
         predicted_iters=best["predicted_iters"],
         predicted_t_s=best["predicted_t_s"],
         static_engine=default_row["engine"],
         static_predicted_t_s=default_row["predicted_t_s"],
+        recycle=recycle,
+        predicted_iters_recycled=(
+            None if rec_iters is None else round(rec_iters, 2)
+        ),
     )
     return chosen, scored
 
@@ -541,6 +586,8 @@ def tune(problem: Problem, dtype=jnp.float32, storage_dtype=None,
         static_engine=chosen.static_engine, demoted=demoted,
         predicted_t_s=chosen.predicted_t_s,
         static_predicted_t_s=chosen.static_predicted_t_s,
+        recycle=chosen.recycle,
+        predicted_iters_recycled=chosen.predicted_iters_recycled,
     )
     return {
         "key": key,

@@ -1,0 +1,223 @@
+"""Semantic solve cache: warm starts for the request mix that repeats.
+
+The fleet's traffic is correlated — the same geometry family, grid
+bucket and ε recur — and ``runtime.autotune`` already buckets exactly
+that recurrence for *executables*. This module applies the same key to
+*solutions*: a bounded map from :func:`runtime.autotune.tune_key`-style
+shape keys to recent (RHS sketch, solution) pairs, consulted at
+admission for a nearest-neighbour warm start ``x0``.
+
+Two design facts carry the whole correctness story:
+
+- **A hit is a hint, never an answer.** The solver's init verifies any
+  ``x0`` by TRUE residual (``solver.pcg.init_state``: r = rhs − A·x0),
+  so the worst a wrong cache entry can do is cost iterations —
+  ``solver.recycle.check_warm_start`` measures the hit's residual ratio
+  at admission and flags ``recycle:bad-hit`` when it is worse than
+  cold. Correctness never depends on cache state, which is also what
+  keeps the serve journal replayable (replays run cold; outcomes are
+  journaled, cache contents never are).
+- **The sketch is deterministic and seeded.** Nearest-neighbour needs a
+  cheap distance between full-grid RHS fields; :func:`rhs_sketch`
+  samples a seed-fixed index set plus two global moments, so the same
+  RHS sketches identically in every process and the cache's decisions
+  replay bit-for-bit from its inputs.
+
+The map itself is bounded on BOTH axes (keys via LRU eviction, entries
+per key via a ring) — the tpulint TPU022 ``unbounded-cache`` discipline
+this module exists to exemplify, not just pass.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_ellipse_tpu.models.problem import Problem
+from poisson_ellipse_tpu.runtime.autotune import tune_key
+
+# sketch size: enough samples that distinct bench RHS families separate
+# by orders of magnitude, small enough that a lookup is microseconds
+SKETCH_DIM = 32
+SKETCH_SEED = 0
+
+# a hit farther than this (relative sketch distance) is declined: an
+# unrelated RHS warm start is pure wasted iterations, and the distance
+# is the only cheap signal admission has
+MAX_DISTANCE = 0.5
+
+# bounded on both axes — see module docstring
+DEFAULT_KEYS = 16
+DEFAULT_PER_KEY = 4
+
+
+def solve_key(problem: Problem, dtype=jnp.float32, storage_dtype=None,
+              geometry=None) -> str:
+    """The cache key — ``runtime.autotune.tune_key`` verbatim: (grid
+    bucket, geometry fingerprint, dtype, storage dtype, norm). A
+    solution is only ever offered to a solve whose operator matches the
+    one that produced it; the RHS axis is the sketch's job."""
+    return tune_key(problem, dtype, storage_dtype=storage_dtype,
+                    geometry=geometry)
+
+
+def rhs_sketch(rhs, dim: int = SKETCH_DIM, seed: int = SKETCH_SEED,
+               ) -> np.ndarray:
+    """The deterministic RHS fingerprint: ``dim`` seed-fixed point
+    samples plus the field's (mean, RMS) moments, as float64.
+
+    The index set depends only on (shape, dim, seed) — the same RHS
+    sketches identically across processes and replays — and the two
+    moments catch what sparse sampling can miss (a global rescale, a
+    sign flip). Moments are per-node (mean/RMS, not sum/norm) so they
+    sit on the same scale as the point samples and can't compress the
+    distance between unrelated fields that merely share a norm.
+    Distances between sketches track relative RHS distance well enough
+    to rank cache entries; admission never *trusts* the ranking (the
+    true-residual check is downstream).
+    """
+    flat = np.asarray(rhs, dtype=np.float64).ravel()
+    if flat.size == 0:
+        return np.zeros(int(dim) + 2)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed), flat.size])
+    )
+    idx = rng.choice(flat.size, size=min(int(dim), flat.size),
+                     replace=False)
+    samples = flat[idx]
+    if samples.size < dim:
+        samples = np.pad(samples, (0, int(dim) - samples.size))
+    return np.concatenate([
+        samples, [flat.mean(), np.sqrt(np.mean(flat * flat))]
+    ])
+
+
+def sketch_distance(s1: np.ndarray, s2: np.ndarray) -> float:
+    """Relative distance between two sketches (0 = identical): the
+    Euclidean gap over the larger magnitude, so the same-family check
+    is scale-free."""
+    n1 = float(np.linalg.norm(s1))
+    n2 = float(np.linalg.norm(s2))
+    denom = max(n1, n2)
+    if denom == 0.0:
+        return 0.0
+    return float(np.linalg.norm(s1 - s2)) / denom
+
+
+class CacheEntry(NamedTuple):
+    """One cached solution: the sketch it answers to and the solution
+    field offered as ``x0`` (held as the device/host array the caller
+    stored — the cache never copies a full grid)."""
+
+    sketch: np.ndarray
+    x0: object
+    iters: int | None
+
+
+class CacheStats(NamedTuple):
+    hits: int
+    misses: int
+    declined: int  # nearest neighbour existed but was too far
+    evicted: int
+    keys: int
+    entries: int
+
+
+class SolveCache:
+    """Bounded per-shape solution cache with nearest-neighbour lookup.
+
+    ``max_keys`` shape keys (LRU-evicted), ``per_key`` entries per key
+    (oldest-evicted ring) — both hard bounds, so a serving process's
+    memory is capped at ``max_keys × per_key`` grids no matter what the
+    traffic does. Host-side and unlocked by design: every consumer owns
+    its instance (the scheduler's batch contexts hold one per bucket),
+    so there is no cross-thread sharing to lock against.
+    """
+
+    def __init__(self, max_keys: int = DEFAULT_KEYS,
+                 per_key: int = DEFAULT_PER_KEY,
+                 max_distance: float = MAX_DISTANCE,
+                 sketch_dim: int = SKETCH_DIM,
+                 sketch_seed: int = SKETCH_SEED):
+        if max_keys < 1 or per_key < 1:
+            raise ValueError("cache bounds must be >= 1")
+        self.max_keys = int(max_keys)
+        self.per_key = int(per_key)
+        self.max_distance = float(max_distance)
+        self.sketch_dim = int(sketch_dim)
+        self.sketch_seed = int(sketch_seed)
+        # key -> list[CacheEntry]; bounded: LRU over keys (move_to_end +
+        # popitem), oldest-out ring per key (del [0]) — the TPU022
+        # eviction routes, load-bearing not decorative
+        self._entries: OrderedDict[str, list[CacheEntry]] = OrderedDict()
+        self._hits = 0
+        self._misses = 0
+        self._declined = 0
+        self._evicted = 0
+
+    def _sketch(self, rhs) -> np.ndarray:
+        return rhs_sketch(rhs, dim=self.sketch_dim, seed=self.sketch_seed)
+
+    def put(self, key: str, rhs, solution, iters: int | None = None
+            ) -> None:
+        """Store one solved (rhs, solution) under ``key``, evicting as
+        the bounds require."""
+        ring = self._entries.get(key)
+        if ring is None:
+            while len(self._entries) >= self.max_keys:
+                self._entries.popitem(last=False)
+                self._evicted += 1
+            ring = []
+            self._entries[key] = ring
+        self._entries.move_to_end(key)
+        ring.append(CacheEntry(
+            sketch=self._sketch(rhs), x0=solution,
+            iters=None if iters is None else int(iters),
+        ))
+        if len(ring) > self.per_key:
+            del ring[0]
+            self._evicted += 1
+
+    def lookup(self, key: str, rhs):
+        """The admission consult: ``(x0, distance)`` of the nearest
+        cached neighbour under ``key``, or ``(None, None)`` on a miss
+        (unknown key, or nearest too far — see ``max_distance``)."""
+        ring = self._entries.get(key)
+        if not ring:
+            self._misses += 1
+            return None, None
+        self._entries.move_to_end(key)
+        sketch = self._sketch(rhs)
+        best = min(
+            ring, key=lambda e: sketch_distance(sketch, e.sketch)
+        )
+        dist = sketch_distance(sketch, best.sketch)
+        if dist > self.max_distance:
+            self._declined += 1
+            return None, dist
+        self._hits += 1
+        return best.x0, dist
+
+    def drop(self, key: str) -> None:
+        """Forget one shape's entries (a poisoned family, a retired
+        bucket)."""
+        self._entries.pop(key, None)
+
+    def clear(self) -> None:
+        """Forget everything — the mesh-degrade/rejoin path: a rebuilt
+        fleet rebuilds its cache from live traffic, never from state
+        that predates the event."""
+        self._entries.clear()
+
+    def stats(self) -> CacheStats:
+        return CacheStats(
+            hits=self._hits, misses=self._misses, declined=self._declined,
+            evicted=self._evicted, keys=len(self._entries),
+            entries=sum(len(r) for r in self._entries.values()),
+        )
+
+    def __len__(self) -> int:
+        return sum(len(r) for r in self._entries.values())

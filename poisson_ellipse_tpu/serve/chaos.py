@@ -126,6 +126,8 @@ def run_chaos(
     tenant_mix: Optional[Sequence[tuple[str, int]]] = None,
     class_quotas: Optional[dict] = None,
     starvation_after_s: Optional[float] = None,
+    warm_start: bool = False,
+    poison_request: Optional[int] = None,
 ) -> ChaosReport:
     """Drive one seeded chaos stream; see the module docstring.
 
@@ -197,6 +199,15 @@ def run_chaos(
     report adds per-tenant outcome counts and pins that no tenant
     starved silently. At EVERY boundary the router's co-ownership
     audit runs; any id live-owned by two replicas fails the report.
+
+    ``warm_start`` runs the whole drill with the per-bucket recycle
+    pools ON (``runtime.solvecache``) — the zero-lost/zero-double/
+    all-classified triple must hold unchanged with recycling enabled,
+    and replays still run cold (the journal contract).
+    ``poison_request`` names the arrival index whose solve-cache
+    consult is replaced with a deliberately wrong entry
+    (``faultinject.cache_poison``): the victim must still terminate
+    classified — extra iterations are the only allowed cost.
     """
     if n_requests < 1:
         raise ValueError("need at least one request")
@@ -284,6 +295,16 @@ def run_chaos(
             "degenerate_geometry",
             request_id=_chaos_id(degenerate_request),
         ))
+    if poison_request is not None and poison_request < n_requests:
+        if not warm_start:
+            raise ValueError(
+                "poison_request targets the solve-cache consult; it needs "
+                "warm_start=True (a cache-off drill has no consult to "
+                "poison)"
+            )
+        faults.append(Fault(
+            "cache_poison", request_id=_chaos_id(poison_request),
+        ))
 
     def make_scheduler():
         return Scheduler(
@@ -295,6 +316,7 @@ def run_chaos(
             ),
             faults=FaultPlan(*faults),
             keep_solutions=False,
+            warm_start=warm_start,
         )
 
     t0 = time.monotonic()

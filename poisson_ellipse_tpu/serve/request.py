@@ -114,6 +114,12 @@ class ServeRequest:
     not_before: float = 0.0
     attempt: int = 0
     dispatched: bool = False
+    # journal-replayed (or peer-adopted) requests run COLD: the solve
+    # cache is in-memory host state the journal never records, so a
+    # replay's outcome must not depend on what it held — skipping the
+    # warm-start consult is what pins replayed outcomes bit-identical
+    # regardless of cache state (the chaos invariant)
+    replayed: bool = False
     # the parsed SDF tree, cached after admission validation
     _geom_obj: object = dataclasses.field(
         default=None, repr=False, compare=False

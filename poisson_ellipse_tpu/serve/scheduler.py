@@ -62,6 +62,7 @@ from poisson_ellipse_tpu.ops import assembly
 from poisson_ellipse_tpu.resilience.errors import SolveError
 from poisson_ellipse_tpu.resilience.faultinject import Fault, FaultPlan
 from poisson_ellipse_tpu.runtime.compile_cache import grid_bucket
+from poisson_ellipse_tpu.runtime.solvecache import SolveCache, solve_key
 from poisson_ellipse_tpu.serve.journal import RequestJournal
 from poisson_ellipse_tpu.serve.queue import AdmissionQueue
 from poisson_ellipse_tpu.serve.request import ServeRequest, ServeResult
@@ -166,7 +167,8 @@ class _InFlight:
     iteration it swapped in (``base_k`` — per-request iteration counts
     are ``iters[lane] - base_k``)."""
 
-    __slots__ = ("req", "lane", "base_k", "t_dispatch")
+    __slots__ = ("req", "lane", "base_k", "t_dispatch", "cache_key",
+                 "rhs_pad")
 
     def __init__(self, req: ServeRequest, lane: int, base_k: int,
                  t_dispatch: float):
@@ -174,6 +176,12 @@ class _InFlight:
         self.lane = lane
         self.base_k = base_k
         self.t_dispatch = t_dispatch
+        # warm-start bookkeeping (None when the pool was not consulted):
+        # the request's solve-cache key and its EMBEDDED rhs — what the
+        # retirement path needs to deposit the converged lane back into
+        # the bucket's pool
+        self.cache_key: Optional[str] = None
+        self.rhs_pad = None
 
 
 class _BatchCtx:
@@ -218,6 +226,12 @@ class _BatchCtx:
         # per-bucket chunk override (None = the scheduler-wide default);
         # set at admission from the autotune registry (Scheduler._ctx_for)
         self.chunk: Optional[int] = None
+        # the bucket's recycle pool (``runtime.solvecache``): bounded on
+        # both axes, owned by THIS context — a mesh degrade/rejoin drops
+        # the context (_degrade_mesh's _ctxs.clear()) and the pool dies
+        # with it, so rebuilt batches never warm-start from state that
+        # predates the event. None when the scheduler runs cache-off.
+        self.pool: Optional[SolveCache] = None
 
     @property
     def active(self) -> bool:
@@ -258,6 +272,7 @@ class Scheduler:
         mesh=None,
         class_quotas: Optional[dict] = None,
         starvation_after_s: Optional[float] = None,
+        warm_start: bool = False,
     ):
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
@@ -273,6 +288,14 @@ class Scheduler:
         self.faults = faults if faults is not None else FaultPlan()
         self.keep_solutions = keep_solutions
         self.mesh = mesh
+        # warm_start=True turns on the per-bucket recycle pools: fresh
+        # attempt-0 requests consult their bucket's SolveCache for a
+        # nearest-neighbour x0 (the semantic cache), converged lanes
+        # deposit back. OFF by default: a warm-started lane's solution
+        # bits legitimately differ from a cold solve's (same l2, fewer
+        # iterations), so the bit-parity pins of the cold serving path
+        # stay the default contract and recycling is an explicit opt-in.
+        self.warm_start = warm_start
         self.journal = (
             RequestJournal(journal) if isinstance(journal, (str, bytes))
             or hasattr(journal, "__fspath__") else journal
@@ -446,6 +469,7 @@ class Scheduler:
         replayed one."""
         if self.journal is not None:
             self.journal.record_admit(req)
+        req.replayed = True
         self._replay_backlog.append(req)
         self._admit_replay_wave()
 
@@ -582,6 +606,10 @@ class Scheduler:
             raise ValueError("replay needs a journal-backed scheduler")
         reqs = self.journal.unfinished(self.clock())
         for req in reqs:
+            # replays run cold (ServeRequest.replayed): the cache is
+            # never journaled, so a replayed outcome must not depend on
+            # what it held — bit-identical regardless of cache state
+            req.replayed = True
             obs_trace.event(
                 "serve:replay", request_id=req.request_id,
                 grid=[req.problem.M, req.problem.N],
@@ -742,6 +770,8 @@ class Scheduler:
                     "autotune:serve-chunk", bucket=list(bucket),
                     chunk=ctx.chunk,
                 )
+            if self.warm_start:
+                ctx.pool = SolveCache()
             self._ctxs[key] = ctx
         return ctx
 
@@ -770,6 +800,7 @@ class Scheduler:
         lane's trajectory is bit-identical to a fresh lane-0 solve of
         the same embedding (pinned in ``tests/test_batched.py``)."""
         p = req.problem
+        x0_p, cache_key = None, None
         if req.grad:
             # grad kind: the job's differentiably-assembled operands
             # (primal stage) or the normalised cotangent RHS over the
@@ -782,6 +813,10 @@ class Scheduler:
                 p, ctx.bucket, self._np_dtype,
                 geometry=req.geometry_sdf(), theta=req.theta,
             )
+            if self.warm_start:
+                x0_p, cache_key = self._consult_pool(
+                    ctx, req, a_p, b_p, r_p
+                )
         # the lane's fresh carry comes from the same eager init_state
         # every other entry path uses (the bit-parity pin's reference);
         # the scatter into the batch is one fused dispatch
@@ -789,6 +824,7 @@ class Scheduler:
             ctx.proto, jnp.asarray(a_p)[None], jnp.asarray(b_p)[None],
             jnp.asarray(r_p)[None], mask=jnp.asarray(m_p)[None],
             h1=p.h1, h2=p.h2,
+            x0=None if x0_p is None else jnp.asarray(x0_p)[None],
         )
         (ctx.a3, ctx.b3, ctx.mask, ctx.h1, ctx.h2, ctx.delta,
          ctx.state) = _refill_scatter(
@@ -800,7 +836,13 @@ class Scheduler:
         )
         base_k = int(ctx.state[_IDX["k"]])
         now = self.clock()
-        ctx.slots[lane] = _InFlight(req, lane, base_k, now)
+        slot = _InFlight(req, lane, base_k, now)
+        if cache_key is not None:
+            # remember what the retirement deposit needs: the key and
+            # the embedded rhs the pool sketches on
+            slot.cache_key = cache_key
+            slot.rhs_pad = r_p
+        ctx.slots[lane] = slot
         req.dispatched = True
         if req.enqueued_t is not None:
             obs_metrics.histogram("time_in_queue_seconds").observe(
@@ -812,6 +854,76 @@ class Scheduler:
             base_k=base_k, attempt=req.attempt,
             bucket=list(ctx.bucket),
         )
+
+    def _consult_pool(self, ctx: _BatchCtx, req: ServeRequest,
+                      a_p, b_p, r_p):
+        """The warm-start consult (``warm_start=True`` refills only):
+        look the request up in its bucket's recycle pool and admit the
+        nearest-neighbour hit through the true-residual check. Returns
+        ``(x0 or None, cache_key)`` — the key always, so the retirement
+        deposit works even on a miss.
+
+        Only FRESH work consults: attempt-0 (a retried request's lane
+        already went bad once — run it cold), never replays (the journal
+        contract: a replayed outcome must not depend on cache state).
+        Everything downstream is defensive — ``check_warm_start`` drops
+        non-finite seeds and flags bad ones (``recycle:bad-hit``), and
+        the batched init verifies by true residual — so the worst any
+        entry (including a ``cache_poison``-injected one) costs is
+        iterations."""
+        from poisson_ellipse_tpu.solver import recycle
+
+        p = req.problem
+        key = solve_key(p, self.dtype, geometry=req.geometry)
+        x0, dist = None, None
+        if (ctx.pool is not None and req.attempt == 0
+                and not req.replayed):
+            x0, dist = ctx.pool.lookup(key, r_p)
+        poisoned = self._cache_poison_fault(req)
+        if poisoned:
+            from poisson_ellipse_tpu.resilience import faultinject
+
+            x0 = faultinject.poisoned_guess(r_p.shape, self._np_dtype)
+        if x0 is None:
+            return None, key
+        # validate on the TRUE grid (the zero-extension pad slices off
+        # exactly): the ratio is measured against the request's own
+        # operator and spacings, not the bucket's
+        g1, g2 = p.M + 1, p.N + 1
+        x0 = np.asarray(x0, self._np_dtype)
+        checked, ratio = recycle.check_warm_start(
+            p, a_p[:g1, :g2], b_p[:g1, :g2], r_p[:g1, :g2],
+            jnp.asarray(x0[:g1, :g2]), source="solvecache",
+            request_id=req.request_id,
+        )
+        if checked is None:
+            return None, key
+        out = np.zeros_like(r_p)
+        out[:g1, :g2] = np.asarray(checked)
+        obs_metrics.counter("solvecache_hit_total").inc()
+        obs_trace.event(
+            "recycle:hit", request_id=req.request_id,
+            distance=dist, ratio=ratio, poisoned=poisoned,
+        )
+        return out, key
+
+    def _cache_poison_fault(self, req: ServeRequest) -> bool:
+        """Fire a pending ``cache_poison`` fault addressed to ``req``
+        (one-shot, like every injection): the consult's answer gets
+        replaced with a deliberately wrong solution."""
+        from poisson_ellipse_tpu.resilience import faultinject
+
+        for fault in self.faults.faults:
+            if (fault.fired or fault.request_id != req.request_id
+                    or fault.kind not in faultinject.CACHE_KINDS):
+                continue
+            fault.fired = True
+            obs_trace.event(
+                "serve:fault", request_id=req.request_id, lane=None,
+                kind=fault.kind, at_iter=0,
+            )
+            return True
+        return False
 
     def _park_lane(self, ctx: _BatchCtx, lane: int) -> None:
         """Return a lane to the parked pool: zeroed state, breakdown
@@ -986,6 +1098,16 @@ class Scheduler:
         if self.keep_solutions and (converged or partial):
             g1, g2 = req.problem.M + 1, req.problem.N + 1
             w = np.asarray(ctx.state[_IDX["w"]][lane])[:g1, :g2].copy()
+        if (converged and slot.cache_key is not None
+                and ctx.pool is not None):
+            # the deposit half of the recycle pool: a converged lane's
+            # EMBEDDED solution under its cache key, sketched on the
+            # same embedded rhs a future consult will sketch on
+            ctx.pool.put(
+                slot.cache_key, slot.rhs_pad,
+                np.asarray(ctx.state[_IDX["w"]][lane]).copy(),
+                iters=iters,
+            )
         self._park_lane(ctx, lane)
         self.queue.observe_service(now - slot.t_dispatch)
         result = ServeResult(

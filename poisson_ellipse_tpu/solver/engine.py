@@ -141,6 +141,11 @@ from poisson_ellipse_tpu.solver.pcg import PCGResult, pcg
 #                                    survivability invariants hold and
 #                                    the chaos verdict is sensitive to
 #                                    each of them
+#                 recycle          — recycle=None/x0=None trace the
+#                                    byte-identical default jaxpr and
+#                                    the sharded deflated init folds k
+#                                    deflation dots into one stacked
+#                                    psum (2 total, zero loop bodies)
 #               A row WITHOUT this key is itself a finding: registering
 #               an engine means declaring its structural contract.
 ENGINE_CAPS = {
@@ -158,7 +163,7 @@ ENGINE_CAPS = {
                 contracts=dict(sharded_psum=2, sharded_halo=1, abft=True,
                                guard="classical", storage_identity=True,
                                storage_narrow=True, history_resident=True,
-                               fleet_chaos=True)),
+                               fleet_chaos=True, recycle=True)),
     "fused": dict(family="loop", storage=False, history=True,
                   capacity=None, precond_kind=None, tunables={},
                   contracts=dict(sharded_psum=2, sharded_halo=1,

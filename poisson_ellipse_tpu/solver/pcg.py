@@ -60,7 +60,8 @@ class PCGResult(NamedTuple):
 
 
 def init_state(problem: Problem, a, b, rhs, history: bool = False,
-               precond=None, storage_dtype=None, x0=None):
+               precond=None, storage_dtype=None, x0=None,
+               recycle: int | None = None):
     """The PCG carry at iteration 0 (the resumable solver state).
 
     Layout: (k, w, r, p, zr, diff, converged, breakdown) — everything the
@@ -83,6 +84,12 @@ def init_state(problem: Problem, a, b, rhs, history: bool = False,
     loop with the F-cycle solution and the loop *verifies* it against δ
     instead of trusting it. ``x0=None`` is byte-identical to the
     historical zero start (r = rhs, no stencil application).
+
+    ``recycle`` appends a (cap, M+1, N+1) Lanczos-vector ring
+    (``solver.recycle``) as the LAST carry element — after the history
+    buffers when both ride — holding ``recycle`` basis vectors at
+    compute width, slot 0 seeded with v₁ here. ``recycle=None`` leaves
+    the carry untouched (jaxpr-pinned).
     """
     dtype = rhs.dtype
     st = resolve_storage_dtype(storage_dtype, dtype)
@@ -107,11 +114,22 @@ def init_state(problem: Problem, a, b, rhs, history: bool = False,
     )
     if history:
         state = state + history_init(problem.max_iterations, dtype)
+    if recycle:
+        from poisson_ellipse_tpu.solver.recycle import ring_init
+
+        # slot 0 = v₁ = z₀/√(z₀,r₀), the first Lanczos basis vector of
+        # M⁻¹A in the M-inner product (solver.recycle's capture contract)
+        ring = ring_init(problem, int(recycle), dtype)
+        ok = zr0 > 0
+        v1 = z0 * lax.rsqrt(jnp.where(ok, zr0, 1.0))
+        ring = ring.at[0].set(jnp.where(ok, v1, ring[0]))
+        state = state + (ring,)
     return state
 
 
 def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla",
-            history: bool = False, precond=None, storage_dtype=None):
+            history: bool = False, precond=None, storage_dtype=None,
+            recycle: int | None = None):
     """Advance the PCG carry until convergence/breakdown or iteration
     ``limit`` (defaults to max_iterations). Returns the new carry.
 
@@ -135,6 +153,15 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
     compute dtype in the consumer (XLA fuses the convert — the HBM read
     stays storage-width), every store rounds back down. None traces the
     byte-identical full-width loop.
+
+    ``recycle`` expects/returns the ring-extended carry of
+    ``init_state(..., recycle=cap)`` and scatters each iteration's
+    Lanczos basis vector (the scaled preconditioned residual) into the
+    appended ring (``solver.recycle``'s Krylov-recycling capture) —
+    pure extra on-device stores, the same DUS discipline as the history
+    buffers, so the iterate trajectory is bit-identical either way;
+    with it off the traced computation is exactly the ringless one
+    (jaxpr-pinned).
     """
     dtype = rhs.dtype
     st = resolve_storage_dtype(storage_dtype, dtype)
@@ -252,8 +279,22 @@ def advance(problem: Problem, a, b, rhs, state, limit=None, stencil: str = "xla"
             # obs.convergence's recording contract; pure stores, no
             # effect on the iterates
             out = out + history_record(
-                state[8:], k, zr_new, diff,
+                state[8:12] if recycle else state[8:], k, zr_new, diff,
                 jnp.where(breakdown, 0.0, alpha), beta,
+            )
+        if recycle:
+            from poisson_ellipse_tpu.solver.recycle import ring_record
+
+            # slot k+1 = v_{k+2} = (−1)^{k+1} z_{k+1}/√(z,r)_{k+1}: the
+            # next Lanczos basis vector, from arrays this body already
+            # materialises — the host-side harvest pairs the ring with
+            # the trace's tridiagonal to form approximate Ritz vectors;
+            # pure stores, no effect on the iterates
+            zr_ok = zr_new > 0
+            sign = jnp.where(k % 2 == 0, -1.0, 1.0).astype(dtype)
+            v_next = sign * z * lax.rsqrt(jnp.where(zr_ok, zr_new, 1.0))
+            out = out + (
+                ring_record(state[-1], k + 1, v_next, ~breakdown & zr_ok),
             )
         return out
 
@@ -270,7 +311,8 @@ def result_of(state) -> PCGResult:
 
 
 def pcg(problem: Problem, a, b, rhs, stencil: str = "xla",
-        history: bool = False, precond=None, storage_dtype=None):
+        history: bool = False, precond=None, storage_dtype=None,
+        x0=None, recycle: int | None = None):
     """Run PCG for pre-assembled coefficients. All inputs (M+1, N+1).
 
     Jit-safe with ``problem`` static; the while_loop carries
@@ -295,15 +337,32 @@ def pcg(problem: Problem, a, b, rhs, stencil: str = "xla",
     byte-identical to the historical loop. The product path for bf16 is
     the guard (``resilience.guard``), whose ladder recovers full-width
     accuracy; the raw engine converges to the storage dtype's floor.
+
+    x0: optional warm start, verified by the TRUE residual at init (see
+    ``init_state``) — a wrong x0 costs iterations, never correctness.
+    None is byte-identical to the zero start.
+
+    recycle: capacity of the on-device search-direction ring
+    (``solver.recycle``). Requires ``history=True`` (the harvest pairs
+    the stored directions with the trace's Lanczos coefficients);
+    returns ``(PCGResult, ConvergenceTrace, ring)``. None traces
+    exactly the ringless computation (jaxpr-pinned).
     """
+    if recycle and not history:
+        raise ValueError(
+            "recycle requires history=True: the Ritz harvest pairs the "
+            "direction ring with the trace's Lanczos coefficients"
+        )
     state = advance(
         problem, a, b, rhs,
         init_state(problem, a, b, rhs, history=history, precond=precond,
-                   storage_dtype=storage_dtype),
+                   storage_dtype=storage_dtype, x0=x0, recycle=recycle),
         stencil=stencil, history=history, precond=precond,
-        storage_dtype=storage_dtype,
+        storage_dtype=storage_dtype, recycle=recycle,
     )
     result = result_of(state)
+    if recycle:
+        return result, trace_of(state[8:12], result.iters), state[-1]
     if history:
         return result, trace_of(state[8:], result.iters)
     return result

@@ -687,3 +687,53 @@ def test_stamp_unreadable_input_exits_2(tmp_path, capsys):
     bad.write_text("{not json")
     assert bc.main(["--stamp", str(bad)]) == 2
     assert bc.main(["--stamp"]) == 2  # missing operand is usage, not crash
+
+
+# ------------------------------------------------------ recycle stream
+
+
+def _recycle_round(cut=4.1, sps_warm=3.15, gap=0.05, converged=True):
+    return make_round(recycle={
+        "grid": [128, 128], "stream": 5, "ring_cap": 64, "basis_rank": 8,
+        "capture_iters": 150, "iters_cold_mean": 149.6,
+        "iters_warm_mean": 36.2, "iter_cut": cut, "l2_rel_gap_max": gap,
+        "solves_per_s_cold": 2.77, "solves_per_s_warm": sps_warm,
+        "converged": converged, "valid": True,
+    })
+
+
+def test_recycle_iter_cut_and_warm_throughput_are_gated():
+    old = _recycle_round()
+    limit = TOL["recycle-pct"]
+    new = _recycle_round(cut=4.1 * (1 - limit) * 0.99)
+    assert ("recycle_iter_cut", "recycle 128x128") in \
+        regressions_between(old, new)
+    new = _recycle_round(sps_warm=3.15 * (1 - limit) * 0.99)
+    assert ("recycle_solves_per_s_warm", "recycle 128x128") in \
+        regressions_between(old, new)
+    # within tolerance (and identical rounds): silent
+    assert regressions_between(old, _recycle_round(cut=4.1 * 0.9)) == []
+    assert regressions_between(old, old) == []
+
+
+def test_recycle_hard_pins_fire_on_the_new_round_alone():
+    # the acceptance pins hold even against an old round that also
+    # carried the key cleanly: >= 2x cut, <= 10% analytic-l2 gap,
+    # every solve in the stream converged
+    regs = regressions_between(_recycle_round(), _recycle_round(cut=1.7))
+    assert ("recycle_cut_pin", "recycle 128x128") in regs
+    regs = regressions_between(_recycle_round(), _recycle_round(gap=0.2))
+    assert ("recycle_l2_gap", "recycle 128x128") in regs
+    regs = regressions_between(
+        _recycle_round(), _recycle_round(converged=False)
+    )
+    assert ("recycle_converged", "recycle 128x128") in regs
+    # ...and fire on a brand-new key with no old counterpart at all
+    regs = regressions_between(make_round(), _recycle_round(cut=1.7))
+    assert ("recycle_cut_pin", "recycle 128x128") in regs
+
+
+def test_recycle_only_in_one_round_is_noted_not_failed():
+    regs, notes = bc.compare(make_round(), _recycle_round(), TOL)
+    assert not regs
+    assert any("recycle" in n for n in notes)

@@ -40,6 +40,7 @@ def test_registry_has_all_twenty_rules():
     assert sorted(RULES) == [f"TPU00{i}" for i in range(1, 10)] + [
         "TPU010", "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
         "TPU016", "TPU017", "TPU018", "TPU019", "TPU020", "TPU021",
+        "TPU022",
     ]
     for code, rule in RULES.items():
         assert rule.code == code
@@ -2249,6 +2250,101 @@ def test_tpu021_suppression_comment():
         AGE = time.time() - 1700000000.0  # tpulint: disable=TPU021
     """
     assert lint_at(src, "pkg/obs/m.py") == []
+
+
+# -- TPU022: unbounded dict caches ------------------------------------------
+
+
+def test_tpu022_positive_module_level_cache():
+    src = """
+        _cache = {}
+
+        def lookup(key, build):
+            if key not in _cache:
+                _cache[key] = build(key)
+            return _cache[key]
+    """
+    assert codes_of(src, select=frozenset({"TPU022"})) == ["TPU022"]
+
+
+def test_tpu022_positive_instance_cache_and_setdefault():
+    src = """
+        class Server:
+            def __init__(self):
+                self.result_cache = dict()
+
+            def handle(self, req):
+                return self.result_cache.setdefault(req.key, req.solve())
+    """
+    assert codes_of(src, select=frozenset({"TPU022"})) == ["TPU022"]
+
+
+def test_tpu022_positive_dataclass_field_memo():
+    src = """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Ctx:
+            memo: dict = dataclasses.field(default_factory=dict)
+
+            def get(self, k, v):
+                self.memo[k] = v
+    """
+    assert codes_of(src, select=frozenset({"TPU022"})) == ["TPU022"]
+
+
+def test_tpu022_negative_evicting_caches_stay_silent():
+    # every house eviction idiom silences the rule: LRU popitem,
+    # clear-on-rebuild, del-by-key, and the drop-the-pool rebind
+    src = """
+        from collections import OrderedDict
+
+        _cache = OrderedDict()
+
+        def put(key, value, cap):
+            _cache[key] = value
+            while len(_cache) > cap:
+                _cache.popitem(last=False)
+
+        class Ctx:
+            def __init__(self):
+                self.pool_cache = {}
+
+            def put(self, k, v):
+                self.pool_cache[k] = v
+
+            def degrade(self):
+                self.pool_cache = {}
+    """
+    assert codes_of(src, select=frozenset({"TPU022"})) == []
+
+
+def test_tpu022_negative_unnamed_dict_and_locals_stay_silent():
+    # a dict not NAMED like a cache is a data structure, not a finding;
+    # a function-local cache dies with the call and stays silent
+    src = """
+        _registry = {}
+
+        def register(name, fn):
+            _registry[name] = fn
+
+        def solve_all(keys, build):
+            cache = {}
+            for k in keys:
+                cache[k] = build(k)
+            return cache
+    """
+    assert codes_of(src, select=frozenset({"TPU022"})) == []
+
+
+def test_tpu022_suppression_comment():
+    src = """
+        _cache = {}  # tpulint: disable=TPU022
+
+        def put(k, v):
+            _cache[k] = v
+    """
+    assert lint_at(src, "pkg/runtime/m.py") == []
 
 
 # -- suppression parsing: real comments only --------------------------------

@@ -661,3 +661,44 @@ def test_fmg_and_autotune_ride_the_table_block(workspace):
     text = readme.read_text()
     assert "Full multigrid as the solver" in text
     assert "Telemetry-driven autotuning" in text
+
+
+def _recycle_key(cut=4.13, valid=True, **overrides):
+    row = {
+        "grid": [128, 128], "stream": 5, "ring_cap": 64, "basis_rank": 8,
+        "capture_iters": 150, "iters_cold_mean": 149.6,
+        "iters_warm_mean": 36.2, "iter_cut": cut, "l2_rel_gap_max": 0.0501,
+        "solves_per_s_cold": 2.77, "solves_per_s_warm": 3.15,
+        "converged": True, "valid": valid,
+    }
+    row.update(overrides)
+    return row
+
+
+def test_recycle_table_rendered_when_present(workspace):
+    _tmp, readme, artifact = workspace
+    artifact.write_text(json.dumps(make_artifact(recycle=_recycle_key())))
+    urb.regenerate(str(readme), str(artifact))
+    text = readme.read_text()
+    assert "Krylov recycling" in text
+    assert "149.6 → 36.2 | **4.13× cut**" in text
+    assert "2.77 → 3.15 | 5.0% |" in text
+    # a round whose cut fell below the pin renders the broken verdict
+    # loudly instead of a bold headline
+    artifact.write_text(json.dumps(
+        make_artifact(recycle=_recycle_key(cut=1.7, valid=False))
+    ))
+    urb.regenerate(str(readme), str(artifact))
+    assert "1.7× (PIN BROKEN)" in readme.read_text()
+
+
+def test_recycle_absent_or_failed_is_supported(workspace):
+    # pre-recycling artifacts lack the key; a declined capture carries
+    # no iter_cut — neither renders the block
+    _tmp, readme, artifact = workspace
+    urb.regenerate(str(readme), str(artifact))
+    assert "Krylov recycling" not in readme.read_text()
+    assert urb.recycle_lines(make_artifact()) == []
+    assert urb.recycle_lines(
+        make_artifact(recycle={"grid": [128, 128], "valid": False})
+    ) == []

@@ -48,6 +48,11 @@ older artifacts predate newer keys, which must never fail the gate):
   `autotune-pct` between rounds; hard pins in the new round — a tuned
   config that measures slower than the static default (`tuned_loses`)
   or a broken registry round-trip is a regression outright
+- the `recycle` row (Krylov recycling on the correlated stream):
+  `iter_cut` shrinking or warm `solves_per_s_warm` dropping more than
+  `recycle-pct` between rounds; hard pins in the new round — a cut
+  below 2× or an analytic-l2 gap beyond 10% (the equal-accuracy
+  contract of the warm start) is a regression outright
 
 - the `contracts` key (written by `--stamp`): a new round measured
   under a violated engine-contract state is a regression outright, and
@@ -119,6 +124,10 @@ DEFAULT_TOLERANCES = {
     # `tuned_loses` (a tuned config measuring slower than the static
     # default) and a broken registry round-trip are hard pins per round
     "autotune-pct": 0.25,
+    # recycle key (Krylov recycling, solver.recycle): the correlated-
+    # stream iteration cut and warm solves/sec between rounds; the ≥2×
+    # cut and the ≤10% analytic-l2 gap are hard pins per round
+    "recycle-pct": 0.25,
 }
 
 # scalar-row artifact keys carrying {grid, t_solver_s, iters}
@@ -664,6 +673,60 @@ def compare(old: dict, new: dict, tol: dict) -> tuple[list[Regression], list[str
             ))
     if bool(o_at) != bool(n_at):
         notes.append("autotune: only in one round, skipped")
+
+    # the recycle key: the correlated-stream iteration cut and warm
+    # solves/sec under `recycle-pct` between rounds, plus the hard pins
+    # in the new round — the ≥2× cut (the ISSUE's acceptance number)
+    # and the ≤10% analytic-l2 gap (a warm start must buy iterations,
+    # never accuracy) are regressions outright
+    def recycle_row(rec):
+        row = rec.get("recycle")
+        return row if isinstance(row, dict) and row.get("grid") else None
+
+    o_rc, n_rc = recycle_row(old), recycle_row(new)
+    if o_rc is not None and n_rc is not None:
+        where_rc = f"recycle {_grid_label(tuple(n_rc['grid']))}"
+        limit = tol["recycle-pct"]
+        o_cut, n_cut = o_rc.get("iter_cut"), n_rc.get("iter_cut")
+        if not one_sided("recycle iter_cut", where_rc, o_cut, n_cut) and \
+                o_cut and n_cut is not None:
+            if n_cut < o_cut * (1.0 - limit):
+                regressions.append(Regression(
+                    "recycle_iter_cut", where_rc, o_cut, n_cut,
+                    f"-{(1 - n_cut / o_cut):.0%} > -{limit:.0%}",
+                ))
+        o_s = o_rc.get("solves_per_s_warm")
+        n_s = n_rc.get("solves_per_s_warm")
+        if not one_sided("recycle solves_per_s_warm", where_rc, o_s, n_s) \
+                and o_s and n_s is not None:
+            if n_s < o_s * (1.0 - limit):
+                regressions.append(Regression(
+                    "recycle_solves_per_s_warm", where_rc, o_s, n_s,
+                    f"-{(1 - n_s / o_s):.0%} > -{limit:.0%}",
+                ))
+    if n_rc is not None:
+        where_rc = f"recycle {_grid_label(tuple(n_rc['grid']))}"
+        n_cut = n_rc.get("iter_cut")
+        if n_cut is not None and n_cut < 2.0:
+            regressions.append(Regression(
+                "recycle_cut_pin", where_rc, 2.0, n_cut,
+                "correlated-stream iteration cut below the 2x "
+                "acceptance pin",
+            ))
+        gap = n_rc.get("l2_rel_gap_max")
+        if gap is not None and gap > 0.10:
+            regressions.append(Regression(
+                "recycle_l2_gap", where_rc, 0.10, gap,
+                "warm-stream analytic l2 left the 10% equal-accuracy "
+                "band",
+            ))
+        if n_rc.get("converged") is False:
+            regressions.append(Regression(
+                "recycle_converged", where_rc, 1, 0,
+                "a solve in the recycle stream failed to converge",
+            ))
+    if (o_rc is None) != (n_rc is None):
+        notes.append("recycle: only in one round, skipped")
 
     # the contracts key (--stamp): two perf numbers are only comparable
     # under the same, clean engine-contract state — a new round measured

@@ -222,6 +222,9 @@ def table_block(rec: dict, src: str) -> str:
     autotune = autotune_lines(rec)
     if autotune:
         lines += [""] + autotune
+    recycle = recycle_lines(rec)
+    if recycle:
+        lines += [""] + recycle
     return "\n".join(lines)
 
 
@@ -306,6 +309,44 @@ def autotune_lines(rec: dict) -> list[str]:
             f"{verdict} |"
         )
     return lines
+
+
+def recycle_lines(rec: dict) -> list[str]:
+    """Markdown for the artifact's ``recycle`` key (Krylov recycling on
+    the correlated stream, emitted since solver/recycle landed):
+    cold-vs-warm iterations, the measured cut against the ≥2× pin, and
+    solves/sec both ways. Pre-recycling artifacts lack the key and
+    render without the block; a failed row (no iter_cut — the capture
+    solve or harvest declined) is skipped, not a crash."""
+    rc = rec.get("recycle")
+    if not isinstance(rc, dict):
+        return []
+    if not rc.get("grid") or rc.get("iter_cut") is None:
+        return []
+    M, N = rc["grid"]
+    verdict = (
+        f"**{rc['iter_cut']:g}× cut**" if rc.get("valid")
+        else f"{rc['iter_cut']:g}× (PIN BROKEN)"
+    )
+    gap = rc.get("l2_rel_gap_max")
+    return [
+        "Krylov recycling (`solver.recycle` + `runtime.solvecache`: one "
+        "ring-carrying capture solve harvests a "
+        f"{rc.get('basis_rank', '?')}-mode deflation basis, then each "
+        "correlated request warm-starts from the previous solution "
+        "deflated against its true residual; `recycle-pct` gated with "
+        "the ≥2× cut hard-pinned by `tools/bench_compare.py`):",
+        "",
+        "| Grid | stream | iters cold → warm | cut | solves/s cold → "
+        "warm | analytic-l2 gap |",
+        "|---|---|---|---|---|---|",
+        f"| {M}×{N} | {rc.get('stream', '—')} related requests | "
+        f"{rc.get('iters_cold_mean', '—')} → "
+        f"{rc.get('iters_warm_mean', '—')} | {verdict} | "
+        f"{rc.get('solves_per_s_cold', '—')} → "
+        f"{rc.get('solves_per_s_warm', '—')} | "
+        + (f"{gap:.1%} |" if gap is not None else "— |"),
+    ]
 
 
 def bandwidth_lines(rec: dict) -> list[str]:
